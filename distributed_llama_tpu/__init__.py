@@ -1,0 +1,22 @@
+"""distributed_llama_tpu — a TPU-native tensor-parallel LLM inference framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+CPU cluster engine `distributed-llama` (see /root/reference): Llama-2/3,
+Mixtral 8x7B and Grok-1 inference with Q40 (4-bit) weights and Q80 (int8)
+quantized activation exchange, tensor-parallel over a `jax.sharding.Mesh`
+instead of root/worker TCP nodes.
+
+Layer map (mirrors SURVEY.md §1, re-architected for TPU):
+
+  quants/    Q40/Q80 block codecs (host numpy + device jnp)       [ref L1]
+  ops/       rmsnorm, rope, attention, activations, matmul paths  [ref L2]
+  parallel/  mesh, partition specs, quantized collectives         [ref L3/L4]
+  models/    llama / mixtral / grok-1 forward definitions         [ref L5/L6]
+  io/        .m model-file and .t tokenizer-file formats          [ref L5/L9]
+  runtime/   KV cache, inference engine, stats                    [ref L4/L7]
+  utils/     xorshift RNG parity, misc                            [ref L0]
+  server/    OpenAI-compatible HTTP API                           [ref L8]
+  tokenizer  BPE encode/decode, sampler                           [ref L7]
+"""
+
+__version__ = "0.1.0"

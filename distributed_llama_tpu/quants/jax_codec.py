@@ -1,0 +1,77 @@
+"""Device-side (jnp) quantization primitives.
+
+`QuantizedTensor` is the canonical HBM-resident form of a Q40 weight matrix:
+a struct-of-arrays (packed nibbles + per-block f16 scales) instead of the
+reference's interleaved 18-byte blocks (ref: src/quants.hpp:16-19) — the
+layout XLA/Pallas can tile: nibble-unpack and scale-multiply fuse into the
+consuming matmul, and both arrays shard cleanly over a mesh axis.
+
+Numerics match the reference decoder (ref: src/quants.cpp:166-179): value =
+(nibble - 8) * f16_scale, lower nibbles are elements [0,16) of the block and
+upper nibbles are elements [16,32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import BLOCK_SIZE
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """Q40 tensor of logical shape (..., n): packed (..., n//32, 16) u8 + scales (..., n//32) f16."""
+
+    packed: jax.Array  # uint8
+    scales: jax.Array  # float16
+
+    def tree_flatten(self):
+        return (self.packed, self.scales), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        s = self.scales.shape
+        return (*s[:-1], s[-1] * BLOCK_SIZE)
+
+    @classmethod
+    def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
+        return cls(jnp.asarray(packed), jnp.asarray(scales))
+
+
+def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    """Unpack Q40 to a dense array of `dtype` with logical shape t.shape."""
+    lo = (t.packed & 0xF).astype(jnp.int8) - 8
+    hi = (t.packed >> 4).astype(jnp.int8) - 8
+    vals = jnp.concatenate([lo, hi], axis=-1)  # (..., nb, 32)
+    out = vals.astype(dtype) * t.scales[..., None].astype(dtype)
+    return out.reshape(*out.shape[:-2], -1)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def quantize_q80_jax(x: jax.Array, block: int = BLOCK_SIZE) -> tuple[jax.Array, jax.Array]:
+    """f32/bf16 (..., n) -> (int8 (..., n//B, B), f16 scales (..., n//B)).
+
+    Device-side equivalent of quantizeQ80Row (ref: src/quants.cpp:182-263);
+    used for Q80-compressed activation exchange between shards.
+    """
+    g = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, block)
+    absmax = jnp.max(jnp.abs(g), axis=-1)
+    scale = absmax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.round(g * inv[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.float16)
+
+
+def dequantize_q80_jax(q: jax.Array, scales: jax.Array, dtype=jnp.float32) -> jax.Array:
+    out = q.astype(dtype) * scales[..., None].astype(dtype)
+    return out.reshape(*out.shape[:-2], -1)

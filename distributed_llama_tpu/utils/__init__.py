@@ -1,0 +1,3 @@
+from .rng import xorshift_u32, xorshift_f32, XorshiftRng
+
+__all__ = ["xorshift_u32", "xorshift_f32", "XorshiftRng"]
