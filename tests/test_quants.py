@@ -105,7 +105,9 @@ def test_q80_jax_roundtrip(rng):
     x = rng.standard_normal((2, 256)).astype(np.float32)
     q, scales = quantize_q80_jax(x)
     y = np.asarray(dequantize_q80_jax(q, scales))
-    assert np.abs(x - y).max() <= 0.01
+    step = np.abs(x.reshape(-1, 32)).max(axis=-1) / 127.0
+    err = np.abs((x - y).reshape(-1, 32))
+    assert (err <= step[:, None] * (0.5 + 127 * 2.0**-11) + 1e-7).all()
     # device quantization matches host quantization up to rounding ties
     s_host, q_host = quantize_q80(x)
     diff = np.abs(np.asarray(q).reshape(q_host.shape).astype(np.int32) - q_host.astype(np.int32))
