@@ -1,0 +1,3 @@
+from .spec import ArchType, HiddenAct, ModelSpec
+
+__all__ = ["ArchType", "HiddenAct", "ModelSpec"]

@@ -1,0 +1,110 @@
+"""Model/tokenizer file format round-trip tests (format parity with the
+reference `.m`/`.t` layouts — ref: src/transformer.cpp:183-291,623-683,
+src/tokenizer.cpp:38-80)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io import (
+    TokenizerData,
+    read_model,
+    read_spec,
+    read_tokenizer_file,
+    write_model,
+    write_tokenizer_file,
+    model_tensor_plan,
+)
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.quants import FloatType
+
+
+def tiny_spec(arch=ArchType.LLAMA, wt=FloatType.F32, **kw):
+    base = dict(
+        arch=arch, dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=32, hidden_act=HiddenAct.SILU, rope_theta=10000.0,
+        weights_float_type=wt,
+    )
+    if arch in (ArchType.MIXTRAL, ArchType.GROK1):
+        base.update(n_experts=4, n_active_experts=2)
+    base.update(kw)
+    return ModelSpec(**base)
+
+
+def random_tensors(spec, rng):
+    return {
+        name: rng.standard_normal(shape).astype(np.float32) * 0.05
+        for name, shape, _ in model_tensor_plan(spec)
+    }
+
+
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL, ArchType.GROK1])
+@pytest.mark.parametrize("wt", [FloatType.F32, FloatType.Q40])
+def test_model_roundtrip(tmp_path, rng, arch, wt):
+    spec = tiny_spec(arch=arch, wt=wt)
+    tensors = random_tensors(spec, rng)
+    path = str(tmp_path / "model.m")
+    write_model(path, spec, tensors)
+
+    spec2 = read_spec(path)
+    assert spec2.arch == arch
+    assert spec2.dim == spec.dim
+    assert spec2.weights_float_type == wt
+    assert spec2.kv_dim == spec.kv_dim
+
+    _, loaded = read_model(path)
+    for name, shape, ftype in model_tensor_plan(spec):
+        got = loaded[name].to_f32()
+        want = tensors[name]
+        if ftype == FloatType.F32:
+            np.testing.assert_array_equal(got, want)
+        else:
+            # Q40: 4-bit round-trip tolerance — the asymmetric +8.5/clamp-15
+            # encoder (converter/writer.py:37-38) loses up to 1.5*scale on
+            # the value opposite the max-magnitude one
+            bound = np.abs(want.reshape(-1, 32)).max(axis=-1) * (1.5 / 8.0) + 1e-5
+            err = np.abs((got - want).reshape(-1, 32))
+            assert (err <= bound[:, None]).all()
+
+
+def test_header_bytes_match_reference_layout(tmp_path, rng):
+    """First 8 bytes: KV magic + header size (ref: converter/writer.py:127-137)."""
+    spec = tiny_spec()
+    path = str(tmp_path / "m.m")
+    write_model(path, spec, random_tensors(spec, rng))
+    raw = open(path, "rb").read(8)
+    magic, header_size = struct.unpack("<ii", raw)
+    assert magic == 0xA00ABCD
+    assert header_size == 8 + 14 * 8  # 14 KV pairs
+
+
+def test_legacy_header(tmp_path):
+    """Old fixed-struct header (ref: src/transformer.cpp:198-213)."""
+    path = str(tmp_path / "legacy.m")
+    vals = dict(dim=64, hidden_dim=128, n_layers=1, n_heads=4, n_kv_heads=4,
+                n_experts=0, n_active_experts=0, vocab_size=32, max_seq_len=16)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<i", 0xABCD00))
+        f.write(struct.pack("<9i", *vals.values()))
+    spec = read_spec(path, weights_float_type=FloatType.F32)
+    assert spec.arch == ArchType.LLAMA
+    assert spec.dim == 64 and spec.seq_len == 16
+    assert spec.rope_theta == 10000.0
+
+
+def test_tokenizer_file_roundtrip(tmp_path):
+    data = TokenizerData(
+        vocab=[b"<unk>", b"<s>", b"</s>", b" ", b"a", b"b", b"ab", b" ab"],
+        scores=[0.0, 0.0, 0.0, -1.0, -2.0, -3.0, -0.5, -0.2],
+        bos_id=1, eos_id=2,
+    )
+    path = str(tmp_path / "tok.t")
+    write_tokenizer_file(path, data)
+    got = read_tokenizer_file(path)
+    assert got.vocab == data.vocab
+    assert got.bos_id == 1 and got.eos_id == 2 and got.pad_id == -1
+    np.testing.assert_allclose(got.scores, data.scores)
+    # header layout parity: 24 bytes, magic first (ref: src/tokenizer.hpp:16-23)
+    raw = open(path, "rb").read(24)
+    assert struct.unpack("<I", raw[:4])[0] == 0x567123

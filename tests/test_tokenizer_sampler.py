@@ -1,0 +1,95 @@
+"""Tokenizer BPE + sampler behavior tests.
+
+The reference ships no tokenizer/sampler tests (SURVEY.md §4 gap); these
+pin the behaviors ported from src/tokenizer.cpp.
+"""
+
+import numpy as np
+
+from distributed_llama_tpu.io.tokenizer_file import TokenizerData
+from distributed_llama_tpu.sampler import Sampler
+from distributed_llama_tpu.tokenizer import Tokenizer
+from distributed_llama_tpu.utils.rng import xorshift_f32
+
+
+def make_tokenizer():
+    # minimal llama2.c-style vocab: 3 specials, 256 byte tokens, then words
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [bytes([i]) if False else f"<0x{i:02X}>".encode() for i in range(256)]
+    words = [b" ", b"a", b"b", b"c", b"ab", b"bc", b"abc", b" abc", b"he", b"llo", b"hello", b" hello"]
+    scores = [0.0] * len(vocab) + [-float(i + 1) for i in range(len(words))]
+    # give longer merges higher scores so greedy merging prefers them
+    vocab += words
+    scores[vocab.index(b"ab")] = -0.5
+    scores[vocab.index(b"abc")] = -0.2
+    scores[vocab.index(b" abc")] = -0.1
+    scores[vocab.index(b"hello")] = -0.3
+    scores[vocab.index(b" hello")] = -0.25
+    scores[vocab.index(b"he")] = -0.6
+    scores[vocab.index(b"llo")] = -0.55
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+
+
+def test_encode_merges_to_longest():
+    tok = make_tokenizer()
+    ids = tok.encode("abc", add_bos=True)
+    assert ids[0] == tok.bos_id
+    assert tok.vocab[ids[-1]] == b" abc"  # dummy space prefix merged in
+    assert len(ids) == 2
+
+
+def test_encode_byte_fallback():
+    tok = make_tokenizer()
+    ids = tok.encode("z", add_bos=False)  # 'z' not in vocab -> byte token +3
+    assert ids[-1] == ord("z") + 3  # ref: src/tokenizer.cpp:184-189
+
+
+def test_decode_strips_bos_space_and_bytes():
+    tok = make_tokenizer()
+    ids = tok.encode("hello", add_bos=True)
+    assert tok.decode(ids) == "hello"
+    # raw byte piece expansion (ref: src/tokenizer.cpp:93-98)
+    assert tok.decode_piece(-1, ord("z") + 3) == b"z"
+
+
+def test_encode_eos():
+    tok = make_tokenizer()
+    ids = tok.encode("a", add_bos=True, add_eos=True)
+    assert ids[-1] == tok.eos_id
+
+
+def test_sampler_greedy():
+    s = Sampler(vocab_size=10, temperature=0.0, topp=0.9, seed=1)
+    logits = np.zeros(10, np.float32)
+    logits[7] = 3.0
+    assert s.sample(logits) == 7
+
+
+def test_sampler_mult_matches_manual_cdf():
+    # ref: src/tokenizer.cpp:244-255 — first index where coin < cdf
+    seed = 42
+    s = Sampler(vocab_size=4, temperature=1.0, topp=0.0, seed=seed)
+    logits = np.log(np.array([0.1, 0.2, 0.3, 0.4], np.float32))
+    _, coin = xorshift_f32(seed)
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    cdf = np.cumsum(probs)
+    expect = int(np.searchsorted(cdf, coin, side="right"))
+    assert s.sample(logits.copy()) == expect
+
+
+def test_sampler_topp_truncates():
+    # with topp=0.5 and a dominant token, only the top token can be sampled
+    s = Sampler(vocab_size=5, temperature=1.0, topp=0.5, seed=7)
+    logits = np.array([10.0, 0.0, 0.0, 0.0, 0.0], np.float32)
+    for _ in range(20):
+        assert s.sample(logits.copy()) == 0
+
+
+def test_sampler_seed_reproducible():
+    logits = np.random.default_rng(0).standard_normal(100).astype(np.float32)
+    a = Sampler(100, 0.8, 0.9, seed=123)
+    b = Sampler(100, 0.8, 0.9, seed=123)
+    seq_a = [a.sample(logits.copy()) for _ in range(10)]
+    seq_b = [b.sample(logits.copy()) for _ in range(10)]
+    assert seq_a == seq_b
