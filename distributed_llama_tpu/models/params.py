@@ -1,0 +1,124 @@
+"""Model parameters as a JAX pytree.
+
+Weights are stacked over layers (leading L axis) so the forward pass can
+`lax.scan` over layers — one compiled layer body instead of the reference's
+flat per-layer task list (ref: src/llama2-tasks.cpp:249-275).
+
+Two storage modes:
+  * dense  — weights dequantized to `dtype` (bf16 on TPU) at load
+  * q40    — weights kept as packed QuantizedTensor in HBM (4.5 bits/weight),
+             dequantized inside the consuming matmul (ref keeps Q40 in RAM
+             and fuses dequant into the kernel: src/funcs.cpp:286-385)
+
+Unsliced tensors (embeddings, norms, wcls, MoE router) mirror the reference's
+root-only tensors (ref: src/transformer.cpp:639-673) by being replicated
+across the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_file import HostTensor, model_tensor_plan
+from ..quants.jax_codec import QuantizedTensor
+from ..quants.numpy_codec import quantize_q40
+from ..quants.types import FloatType
+from .spec import ArchType, ModelSpec
+
+
+def _stack_q40(tensors: list[HostTensor]) -> QuantizedTensor:
+    packed = np.stack([t.packed for t in tensors])
+    scales = np.stack([t.scales for t in tensors])
+    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
+
+
+def _to_q40_host(x: np.ndarray) -> HostTensor:
+    scales, packed = quantize_q40(x.reshape(-1, x.shape[-1]))
+    t = HostTensor("", FloatType.Q40, x.shape, scales=scales, packed=packed)
+    return t
+
+
+def load_params(
+    spec: ModelSpec,
+    tensors: dict[str, HostTensor],
+    mode: str = "dense",
+    dtype=jnp.float32,
+    put: Callable | None = None,
+) -> dict:
+    """Build the params pytree from file tensors.
+
+    `put` optionally maps (name, np/QuantizedTensor host arrays) -> device
+    arrays with a sharding (used by parallel.loader for sharded placement);
+    defaults to plain jnp.asarray.
+    """
+    assert mode in ("dense", "q40")
+    dev = put or (lambda name, x: x if isinstance(x, QuantizedTensor) else jnp.asarray(x))
+
+    def weight(names: list[str], shape_hint: str):
+        """Stack per-layer (or per-layer-per-expert) matmul weights."""
+        ts = [tensors[n] for n in names]
+        if mode == "q40":
+            qs = []
+            for t in ts:
+                if t.ftype == FloatType.Q40:
+                    qs.append(t)
+                else:
+                    qs.append(_to_q40_host(t.to_f32()))
+            packed = np.stack([q.packed for q in qs])
+            scales = np.stack([q.scales for q in qs])
+            return dev(shape_hint, QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales)))
+        dense = np.stack([t.to_f32() for t in ts]).astype(dtype)
+        return dev(shape_hint, dense)
+
+    L = spec.n_layers
+    p: dict = {}
+    p["tok_emb"] = dev("tok_emb", tensors["tok_emb"].to_f32().astype(dtype))
+    p["rms_att"] = dev("rms_att", np.stack([tensors[f"layers.{l}.rms_att"].to_f32() for l in range(L)]))
+    p["rms_ffn"] = dev("rms_ffn", np.stack([tensors[f"layers.{l}.rms_ffn"].to_f32() for l in range(L)]))
+    if spec.arch == ArchType.GROK1:
+        p["rms_moe"] = dev("rms_moe", np.stack([tensors[f"layers.{l}.rms_moe"].to_f32() for l in range(L)]))
+        p["rms_ffn2"] = dev("rms_ffn2", np.stack([tensors[f"layers.{l}.rms_ffn2"].to_f32() for l in range(L)]))
+    for w in ("wq", "wk", "wv", "wo"):
+        p[w] = weight([f"layers.{l}.{w}" for l in range(L)], w)
+    if spec.is_moe:
+        p["moe_router"] = dev(
+            "moe_router",
+            np.stack([tensors[f"layers.{l}.moe_router"].to_f32() for l in range(L)]).astype(dtype),
+        )
+        for w in ("up", "gate", "down"):
+            names = [f"layers.{l}.experts.{e}.{w}" for l in range(L) for e in range(spec.n_experts)]
+            ts = [tensors[n] for n in names]
+            if mode == "q40":
+                qs = [t if t.ftype == FloatType.Q40 else _to_q40_host(t.to_f32()) for t in ts]
+                E = spec.n_experts
+                packed = np.stack([q.packed for q in qs]).reshape(L, E, *qs[0].packed.shape)
+                scales = np.stack([q.scales for q in qs]).reshape(L, E, *qs[0].scales.shape)
+                p[f"moe_{w}"] = dev(f"moe_{w}", QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales)))
+            else:
+                dense = np.stack([t.to_f32() for t in ts]).astype(dtype)
+                p[f"moe_{w}"] = dev(f"moe_{w}", dense.reshape(L, spec.n_experts, *dense.shape[1:]))
+    else:
+        for w in ("w1", "w2", "w3"):
+            p[w] = weight([f"layers.{l}.{w}" for l in range(L)], w)
+    p["rms_final"] = dev("rms_final", tensors["rms_final"].to_f32())
+    p["wcls"] = weight(["wcls"], "wcls")  # stacked with leading dim 1
+    return p
+
+
+def random_tensors(spec: ModelSpec, seed: int = 0, scale: float = 0.02) -> dict[str, HostTensor]:
+    """Synthetic host tensors for tests/benchmarks (numpy RNG, not xorshift —
+    speed matters at 8B scale)."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name, shape, ftype in model_tensor_plan(spec):
+        x = (rng.standard_normal(shape, dtype=np.float32) * scale)
+        if ftype == FloatType.Q40:
+            out[name] = _to_q40_host(x)
+            out[name].name = name
+            out[name].shape = shape
+        else:
+            out[name] = HostTensor(name, FloatType.F32, shape, data=x)
+    return out

@@ -1,0 +1,234 @@
+"""Unified transformer forward for LLAMA / MIXTRAL / GROK1.
+
+One jittable segment-forward covers both prefill (T tokens at once — net-new
+vs the reference, which feeds the prompt token-by-token) and decode (T=1).
+The per-layer dataflow reproduces the reference task pipelines:
+
+  * LLAMA dense block  — ref: src/llama2-tasks.cpp:249-275
+  * MIXTRAL MoE block  — ref: src/mixtral-tasks.cpp:5-51
+  * GROK1 extra norms, input/logit scalings — ref: src/grok1-tasks.cpp:11-41,
+    244-272, 274-326
+
+but the reference's broadcast/gather/merge sync tasks vanish: the row/col
+weight sharding is expressed as PartitionSpecs (parallel/sharding.py) and
+GSPMD inserts the equivalent ICI collectives.
+
+Layers run under `lax.scan` with the KV cache in the carry (updated via
+dynamic_update_slice — the functional form of the reference's in-place
+cache write at src/llama2-tasks.cpp:38-44).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.activations import apply_hidden_act
+from ..ops.attention import decode_attention
+from ..ops.matmul import matmul
+from ..ops.norms import rmsnorm
+from ..ops.rope import apply_rope
+from ..quants.jax_codec import QuantizedTensor
+from .spec import ArchType, ModelSpec
+
+GROK_INPUT_SCALE = 78.38367176906169      # ref: src/grok1-tasks.cpp:13
+GROK_LOGIT_SCALE = 0.5773502691896257     # ref: src/grok1-tasks.cpp:271
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer KV cache: (L, B, S, KVH, hs)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @classmethod
+    def create(cls, spec: ModelSpec, batch: int, seq_len: int | None = None,
+               dtype=jnp.float32) -> "KVCache":
+        s = seq_len or spec.seq_len
+        shape = (spec.n_layers, batch, s, spec.n_kv_heads, spec.head_size)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def _layer_weights(params: dict, spec: ModelSpec) -> dict:
+    """The slice of params that is scanned over layers (leading L axis)."""
+    keys = ["rms_att", "rms_ffn", "wq", "wk", "wv", "wo"]
+    if spec.is_moe:
+        keys += ["moe_router", "moe_up", "moe_gate", "moe_down"]
+    else:
+        keys += ["w1", "w2", "w3"]
+    if spec.arch == ArchType.GROK1:
+        keys += ["rms_moe", "rms_ffn2"]
+    return {k: params[k] for k in keys}
+
+
+def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg):
+    """Norm -> QKV -> RoPE -> cache update -> attention -> output proj.
+
+    Returns (attn_out, new_k_cache, new_v_cache). attn_out is the wo
+    projection NOT yet added to the residual (archs differ there).
+    """
+    b, t, d = x.shape
+    h, kvh, hs = spec.n_heads, spec.n_kv_heads, spec.head_size
+
+    xb = rmsnorm(x, lw["rms_att"])  # ref: llama2-tasks.cpp:10-21
+    q = matmul(xb, lw["wq"], **cfg).reshape(b, t, h, hs)
+    k = matmul(xb, lw["wk"], **cfg).reshape(b, t, kvh, hs)
+    v = matmul(xb, lw["wv"], **cfg).reshape(b, t, kvh, hs)
+
+    q = apply_rope(q, q_pos, spec.rope_theta, spec.arch)
+    k = apply_rope(k, q_pos, spec.rope_theta, spec.arch)
+
+    # functional cache update at positions q_pos (contiguous: pos0..pos0+T)
+    pos0 = q_pos[:, 0]
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, pos0[0], 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, pos0[0], 0, 0))
+
+    att = decode_attention(q, k_cache, v_cache, q_pos)  # (B, T, H, hs)
+    out = matmul(att.reshape(b, t, h * hs), lw["wo"], **cfg)
+    return out, k_cache, v_cache
+
+
+def _dense_ffn(xb, lw, spec: ModelSpec, cfg):
+    """SwiGLU FFN (ref: src/llama2-tasks.cpp:158-189)."""
+    gate = matmul(xb, lw["w1"], **cfg)
+    up = matmul(xb, lw["w3"], **cfg)
+    hb = apply_hidden_act(gate, spec.hidden_act) * up
+    return matmul(hb, lw["w2"], **cfg)
+
+
+def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
+    """Top-k routed expert FFN (ref: src/grok1-tasks.cpp:56-227).
+
+    Router/top-k runs replicated (the reference runs it root-only and
+    broadcasts — ref: grok1-tasks.cpp:121-126). Decode (T==1) gathers only
+    the active experts' weights; prefill computes all experts densely and
+    masks — both compile to static shapes.
+    """
+    b, t, d = xb.shape
+    k_active = spec.n_active_experts
+
+    router_logits = matmul(xb, lw["moe_router"], **cfg)  # (B, T, E)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_p, top_idx = lax.top_k(probs, k_active)           # (B, T, K)
+    weights = top_p / top_p.sum(axis=-1, keepdims=True)   # ref: grok1-tasks.cpp:99-114
+
+    def expert_apply(w_up, w_gate, w_down, x_tok):
+        gate = matmul(x_tok, w_gate, **cfg)
+        up = matmul(x_tok, w_up, **cfg)
+        hb = apply_hidden_act(gate, spec.hidden_act) * up
+        return matmul(hb, w_down, **cfg)
+
+    if t == 1 and b == 1:
+        # decode: gather only the K active experts' weights (the reference
+        # likewise computes just the active experts — grok1-tasks.cpp:128-143)
+        idx = top_idx.reshape(k_active)
+        acc = jnp.zeros((b, t, d), xb.dtype)
+        for ae in range(k_active):  # K is tiny and static — unrolled
+            e = idx[ae]
+            out = expert_apply(
+                _take_expert(lw["moe_up"], e),
+                _take_expert(lw["moe_gate"], e),
+                _take_expert(lw["moe_down"], e),
+                xb,
+            )
+            acc = acc + weights[..., ae, None].astype(out.dtype) * out
+        return acc
+
+    # prefill: dense all-expert compute, mask by routing weights
+    e_weights = jnp.zeros_like(probs).at[
+        jnp.arange(b)[:, None, None],
+        jnp.arange(t)[None, :, None],
+        top_idx,
+    ].set(weights)  # (B, T, E) scatter of normalized weights
+
+    def all_experts(e, acc):
+        up_e = _take_expert(lw["moe_up"], e)
+        gate_e = _take_expert(lw["moe_gate"], e)
+        down_e = _take_expert(lw["moe_down"], e)
+        out = expert_apply(up_e, gate_e, down_e, xb)
+        return acc + e_weights[..., e, None].astype(out.dtype) * out
+
+    acc = jnp.zeros((b, t, d), xb.dtype)
+    for e in range(spec.n_experts):
+        acc = all_experts(e, acc)
+    return acc
+
+
+def _take_expert(w, e):
+    """Select expert e from a stacked (E, ...) weight (dense or Q40)."""
+    if isinstance(w, QuantizedTensor):
+        return QuantizedTensor(
+            lax.dynamic_index_in_dim(w.packed, e, axis=0, keepdims=False),
+            lax.dynamic_index_in_dim(w.scales, e, axis=0, keepdims=False),
+        )
+    return lax.dynamic_index_in_dim(w, e, axis=0, keepdims=False)
+
+
+def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg):
+    attn_out, k_cache, v_cache = _attention_block(x, lw, spec, k_cache, v_cache, q_pos, cfg)
+
+    if spec.arch == ArchType.GROK1:
+        # post-attention norm BEFORE residual add (ref: grok1-tasks.cpp:16-41)
+        x = x + rmsnorm(attn_out, lw["rms_ffn"]).astype(x.dtype)
+        xb = rmsnorm(x, lw["rms_moe"])          # ref: grok1-tasks.cpp:43-54
+        moe_out = _moe_ffn(xb, lw, spec, cfg)
+        moe_out = rmsnorm(moe_out, lw["rms_ffn2"])  # ref: grok1-tasks.cpp:244-256
+        x = x + moe_out.astype(x.dtype)
+    elif spec.arch == ArchType.MIXTRAL:
+        x = x + attn_out.astype(x.dtype)        # ref: mixtral-tasks.cpp:24
+        xb = rmsnorm(x, lw["rms_ffn"])
+        x = x + _moe_ffn(xb, lw, spec, cfg).astype(x.dtype)
+    else:
+        x = x + attn_out.astype(x.dtype)        # ref: llama2-tasks.cpp:125-131
+        xb = rmsnorm(x, lw["rms_ffn"])
+        x = x + _dense_ffn(xb, lw, spec, cfg).astype(x.dtype)
+    return x, k_cache, v_cache
+
+
+def forward(
+    params: dict,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,   # (B, T) int32
+    pos0: jnp.ndarray,     # scalar int32 — first absolute position of the segment
+    cache: KVCache,
+    *,
+    activation_q80: bool = False,
+    compute_dtype=jnp.float32,
+    logits_for_all: bool = False,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run T tokens through the model; returns (logits, updated cache).
+
+    logits: (B, vocab) for the last token, or (B, T, vocab) if logits_for_all.
+    """
+    cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype)
+    b, t = tokens.shape
+
+    x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203
+    if spec.arch == ArchType.GROK1:
+        x = x * GROK_INPUT_SCALE
+
+    q_pos = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q_pos = jnp.broadcast_to(q_pos, (b, t))
+
+    lws = _layer_weights(params, spec)
+
+    def scan_body(x, layer_in):
+        lw, k_cache, v_cache = layer_in
+        x_new, k_new, v_new = _layer(x, lw, spec, k_cache, v_cache, q_pos, cfg)
+        return x_new, (k_new, v_new)
+
+    x, (k_all, v_all) = lax.scan(scan_body, x, (lws, cache.k, cache.v))
+
+    x = rmsnorm(x, params["rms_final"])  # ref: llama2-tasks.cpp:222-234
+    if not logits_for_all:
+        x = x[:, -1, :]
+    wcls = params["wcls"][0]
+    logits = matmul(x, wcls, **cfg).astype(jnp.float32)
+    if spec.arch == ArchType.GROK1:
+        logits = logits * GROK_LOGIT_SCALE  # ref: grok1-tasks.cpp:269-272
+    return logits, KVCache(k_all, v_all)

@@ -1,0 +1,19 @@
+from .norms import rms_inv, rmsnorm
+from .activations import silu, gelu_tanh, apply_hidden_act
+from .rope import rope_llama, rope_falcon, apply_rope
+from .attention import decode_attention
+from .matmul import matmul, WeightFormat
+
+__all__ = [
+    "rms_inv",
+    "rmsnorm",
+    "silu",
+    "gelu_tanh",
+    "apply_hidden_act",
+    "rope_llama",
+    "rope_falcon",
+    "apply_rope",
+    "decode_attention",
+    "matmul",
+    "WeightFormat",
+]

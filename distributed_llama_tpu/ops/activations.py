@@ -1,0 +1,32 @@
+"""Hidden activations, matching the reference's exact formulas
+(ref: src/funcs.cpp:490-506)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..models.spec import HiddenAct
+
+_SQRT_2_OVER_PI = 0.79788456080286535587989211986876
+_GELU_COEF_A = 0.044715
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    # x / (1 + exp(-x)) (ref: src/funcs.cpp:498-506)
+    xf = x.astype(jnp.float32)
+    return (xf / (1.0 + jnp.exp(-xf))).astype(x.dtype)
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    # tanh approximation (ref: src/funcs.cpp:487-496)
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(_SQRT_2_OVER_PI * xf * (1.0 + _GELU_COEF_A * xf * xf)))
+    return out.astype(x.dtype)
+
+
+def apply_hidden_act(x: jnp.ndarray, act: HiddenAct) -> jnp.ndarray:
+    if act == HiddenAct.SILU:
+        return silu(x)
+    if act == HiddenAct.GELU:
+        return gelu_tanh(x)
+    raise ValueError(act)

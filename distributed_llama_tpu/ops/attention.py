@@ -1,0 +1,46 @@
+"""Attention over a pre-filled KV cache.
+
+TPU-native replacement for the reference's serial per-head loop
+(ref: src/llama2-tasks.cpp:54-94): one masked `dot_general` pair that XLA
+tiles onto the MXU, with GQA handled by reshaping query heads into
+(kv_head, group) blocks instead of the reference's `h / kvMul` indexing.
+
+Numerics match the reference: scores = q·k / sqrt(head_size), softmax with
+max-subtraction over positions t <= pos, f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def decode_attention(
+    q: jnp.ndarray,        # (B, T, H, hs) — rotated queries
+    k_cache: jnp.ndarray,  # (B, S, KVH, hs) — cache already updated at query positions
+    v_cache: jnp.ndarray,  # (B, S, KVH, hs)
+    q_pos: jnp.ndarray,    # (B, T) absolute position of each query token
+) -> jnp.ndarray:
+    """Causal attention of T query tokens against the full cache.
+
+    Works for decode (T=1) and chunked prefill (T>1). Returns (B, T, H, hs).
+    """
+    b, t, h, hs = q.shape
+    s = k_cache.shape[1]
+    kvh = k_cache.shape[2]
+    group = h // kvh  # ref kvMul: src/llama2-tasks.cpp:60
+
+    qf = q.astype(jnp.float32).reshape(b, t, kvh, group, hs)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    # scores: (B, T, KVH, G, S)
+    scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / jnp.sqrt(jnp.float32(hs))
+    # causal mask: cache position s visible iff s <= q_pos
+    mask = jnp.arange(s)[None, None, :] <= q_pos[..., None]  # (B, T, S)
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("btkgs,bskh->btkgh", probs, vf)
+    return out.reshape(b, t, h, hs).astype(q.dtype)

@@ -1,0 +1,50 @@
+"""Weight-format-dispatching matmul.
+
+TPU-native equivalent of the reference's matmul dispatcher over (weight dtype
+x input dtype) pairs (ref: src/funcs.cpp:413-454). Weights are stored either
+dense (f32/bf16) or as Q40 `QuantizedTensor`s kept packed in HBM; the Q40
+path dequantizes inline — XLA fuses the nibble-unpack + scale multiply into
+the matmul's operand read, which is the bring-up analogue of the reference's
+fused Q40xQ80 NEON/AVX2 kernel (ref: src/funcs.cpp:286-385). The Pallas
+int4-dot kernel (ops/pallas_q40.py) replaces this on TPU for the hot path.
+
+Convention matches the reference: weight W has logical shape (d, n) (d output
+rows), activations are (..., n), output is (..., d) = x @ W^T.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+
+from ..quants.jax_codec import QuantizedTensor, dequantize_q40_jax, quantize_q80_jax, dequantize_q80_jax
+
+WeightFormat = Union[jnp.ndarray, QuantizedTensor]
+
+
+def matmul(
+    x: jnp.ndarray,
+    w: WeightFormat,
+    *,
+    activation_q80: bool = False,
+    compute_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """y[..., d] = sum_n x[..., n] * W[d, n].
+
+    activation_q80=True round-trips the activation through Q80 blocks first,
+    reproducing the reference's quantized activation buffers
+    (ref: src/tasks.cpp:124-148) for bit-accuracy experiments.
+    """
+    if activation_q80:
+        q, scales = quantize_q80_jax(x)
+        x = dequantize_q80_jax(q, scales, dtype=compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+
+    if isinstance(w, QuantizedTensor):
+        wd = dequantize_q40_jax(w, dtype=compute_dtype)
+    else:
+        wd = w.astype(compute_dtype)
+
+    return jnp.einsum("...n,dn->...d", x, wd, preferred_element_type=compute_dtype)

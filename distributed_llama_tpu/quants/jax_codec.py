@@ -43,6 +43,19 @@ class QuantizedTensor:
         s = self.scales.shape
         return (*s[:-1], s[-1] * BLOCK_SIZE)
 
+    def __getitem__(self, idx) -> "QuantizedTensor":
+        """Index leading (stacking) axes, e.g. per-layer or per-expert slices."""
+        return QuantizedTensor(self.packed[idx], self.scales[idx])
+
+    def take(self, indices, axis: int = 0) -> "QuantizedTensor":
+        """Gather along a leading axis (used for MoE active-expert selection)."""
+        import jax.numpy as jnp
+
+        return QuantizedTensor(
+            jnp.take(self.packed, indices, axis=axis),
+            jnp.take(self.scales, indices, axis=axis),
+        )
+
     @classmethod
     def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
         return cls(jnp.asarray(packed), jnp.asarray(scales))

@@ -1,0 +1,157 @@
+"""Numpy oracle reproducing the reference engine's per-token math.
+
+Serves the role of the reference's golden-block tests
+(ref: src/llama2-tasks-test.cpp:563-582, grok1-tasks-test.cpp:86-90): an
+independent implementation, following the C++ op order (serial per-head
+attention, exact rope formulas, f32 throughout), that the JAX forward is
+checked against. Weights are dense f32 (nSlices=1 equivalent — with one
+slice, the reference's sync tasks are no-ops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec
+
+GROK_INPUT_SCALE = 78.38367176906169
+GROK_LOGIT_SCALE = 0.5773502691896257
+
+
+def rms_norm(x, w):
+    # ref: src/funcs.cpp:94-145
+    inv = 1.0 / np.sqrt((x.astype(np.float32) ** 2).mean() + 1e-5)
+    return w * (inv * x)
+
+
+def softmax(x):
+    # ref: src/funcs.cpp:63-92
+    e = np.exp(x - x.max())
+    return e / e.sum()
+
+
+def act(x, hidden_act):
+    if hidden_act == HiddenAct.SILU:
+        return x / (1.0 + np.exp(-x))
+    c = 0.044715
+    s = 0.79788456080286535587989211986876
+    return 0.5 * x * (1.0 + np.tanh(s * x * (1.0 + c * x * x)))
+
+
+def rope_llama_inplace(v, pos, head_size, theta):
+    # ref: src/transformer.cpp:98-135 — adjacent pairs, freq by (i % headSize)
+    for i in range(0, v.shape[0], 2):
+        head_dim = i % head_size
+        freq = 1.0 / (theta ** (head_dim / head_size))
+        val = pos * freq
+        fcr, fci = np.cos(val), np.sin(val)
+        v0, v1 = v[i], v[i + 1]
+        v[i] = v0 * fcr - v1 * fci
+        v[i + 1] = v0 * fci + v1 * fcr
+
+
+def rope_falcon_inplace(v, pos, head_size, theta):
+    # ref: src/transformer.cpp:137-159 — j pairs with j + hs/2 per head
+    n_heads = v.shape[0] // head_size
+    for h in range(n_heads):
+        for j in range(head_size // 2):
+            freq = 1.0 / (theta ** (2.0 * j / head_size))
+            val = pos * freq
+            fcr, fci = np.cos(val), np.sin(val)
+            a = v[h * head_size + j]
+            b = v[h * head_size + j + head_size // 2]
+            v[h * head_size + j] = a * fcr - b * fci
+            v[h * head_size + j + head_size // 2] = a * fci + b * fcr
+
+
+class Oracle:
+    def __init__(self, spec: ModelSpec, weights: dict[str, np.ndarray]):
+        self.spec = spec
+        self.w = weights
+        s = spec
+        self.k_cache = np.zeros((s.n_layers, s.seq_len, s.kv_dim), np.float32)
+        self.v_cache = np.zeros((s.n_layers, s.seq_len, s.kv_dim), np.float32)
+
+    def _attention(self, l: int, xb: np.ndarray, pos: int) -> np.ndarray:
+        s = self.spec
+        w = self.w
+        p = f"layers.{l}."
+        q = w[p + "wq"] @ xb
+        k = w[p + "wk"] @ xb
+        v = w[p + "wv"] @ xb
+        rope = rope_llama_inplace if s.arch == ArchType.LLAMA else rope_falcon_inplace
+        # note: falcon kv head size = kvDim/nKvHeads == headSize (ref: transformer.cpp:141)
+        rope(q, pos, s.head_size, s.rope_theta)
+        rope(k, pos, s.head_size, s.rope_theta)
+        self.k_cache[l, pos] = k
+        self.v_cache[l, pos] = v
+
+        kv_mul = s.n_heads // s.n_kv_heads
+        out = np.zeros(s.dim, np.float32)
+        hs = s.head_size
+        for h in range(s.n_heads):  # ref: src/llama2-tasks.cpp:54-94
+            qh = q[h * hs:(h + 1) * hs]
+            kvh = h // kv_mul
+            scores = np.array([
+                np.dot(qh, self.k_cache[l, t, kvh * hs:(kvh + 1) * hs]) / np.sqrt(hs)
+                for t in range(pos + 1)
+            ], np.float32)
+            att = softmax(scores)
+            acc = np.zeros(hs, np.float32)
+            for t in range(pos + 1):
+                acc += att[t] * self.v_cache[l, t, kvh * hs:(kvh + 1) * hs]
+            out[h * hs:(h + 1) * hs] = acc
+        return self.w[p + "wo"] @ out
+
+    def _dense_ffn(self, l: int, xb: np.ndarray) -> np.ndarray:
+        s, w = self.spec, self.w
+        p = f"layers.{l}."
+        gate = act(w[p + "w1"] @ xb, s.hidden_act)
+        up = w[p + "w3"] @ xb
+        return w[p + "w2"] @ (gate * up)
+
+    def _moe_ffn(self, l: int, xb: np.ndarray) -> np.ndarray:
+        # ref: src/grok1-tasks.cpp:56-227
+        s, w = self.spec, self.w
+        p = f"layers.{l}."
+        probs = softmax(w[p + "moe_router"] @ xb)
+        order = np.argsort(-probs, kind="stable")
+        idx = order[: s.n_active_experts]
+        wts = probs[idx] / probs[idx].sum()
+        out = np.zeros(s.dim, np.float32)
+        for ae, e in enumerate(idx):
+            pe = p + f"experts.{e}."
+            gate = act(w[pe + "gate"] @ xb, s.hidden_act)
+            up = w[pe + "up"] @ xb
+            out += wts[ae] * (w[pe + "down"] @ (gate * up))
+        return out
+
+    def step(self, token: int, pos: int) -> np.ndarray:
+        s, w = self.spec, self.w
+        x = w["tok_emb"][token].astype(np.float32).copy()
+        if s.arch == ArchType.GROK1:
+            x *= GROK_INPUT_SCALE
+        for l in range(s.n_layers):
+            p = f"layers.{l}."
+            xb = rms_norm(x, w[p + "rms_att"])
+            attn = self._attention(l, xb, pos)
+            if s.arch == ArchType.GROK1:
+                # ref: grok1-tasks.cpp:16-41 — norm before residual add
+                x = x + rms_norm(attn, w[p + "rms_ffn"])
+                xb = rms_norm(x, w[p + "rms_moe"])
+                moe = self._moe_ffn(l, xb)
+                moe = rms_norm(moe, w[p + "rms_ffn2"])
+                x = x + moe
+            elif s.arch == ArchType.MIXTRAL:
+                x = x + attn
+                xb = rms_norm(x, w[p + "rms_ffn"])
+                x = x + self._moe_ffn(l, xb)
+            else:
+                x = x + attn
+                xb = rms_norm(x, w[p + "rms_ffn"])
+                x = x + self._dense_ffn(l, xb)
+        x = rms_norm(x, w["rms_final"])
+        logits = w["wcls"] @ x
+        if s.arch == ArchType.GROK1:
+            logits = logits * GROK_LOGIT_SCALE
+        return logits
