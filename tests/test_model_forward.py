@@ -69,9 +69,10 @@ def test_prefill_equals_tokenwise_decode(arch):
         logits_b, cache_b = forward(
             params, spec, jnp.asarray(toks[:, i:i + 1]), jnp.int32(i), cache_b)
 
-    # identical math, different reduction order (batched vs per-token einsum)
-    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b), rtol=1e-2, atol=5e-5)
-    np.testing.assert_allclose(np.asarray(cache_a.k), np.asarray(cache_b.k), rtol=1e-3, atol=1e-5)
+    # identical math, different f32 reduction order (batched vs per-token
+    # einsum), compounding across layers — absolute tolerance on O(1) values
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b), rtol=0, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(cache_a.k), np.asarray(cache_b.k), rtol=0, atol=1e-3)
 
 
 def test_q40_params_close_to_dense():
