@@ -1,0 +1,16 @@
+from .mesh import make_mesh, TP_AXIS, DP_AXIS, SP_AXIS
+from .sharding import param_pspecs, shard_params, cache_pspec, check_tp_constraints
+from .collectives import q80_psum, q80_all_gather
+
+__all__ = [
+    "make_mesh",
+    "TP_AXIS",
+    "DP_AXIS",
+    "SP_AXIS",
+    "param_pspecs",
+    "shard_params",
+    "cache_pspec",
+    "check_tp_constraints",
+    "q80_psum",
+    "q80_all_gather",
+]

@@ -1,0 +1,38 @@
+"""Device mesh construction.
+
+The reference's cluster shape is `nSlices = nWorkers + 1` CPU nodes in a TCP
+star (ref: src/app.cpp:103-132). Here the cluster is a `jax.sharding.Mesh`
+with named axes:
+
+  dp — data parallel (batch; net-new vs the reference, which is batch=1)
+  sp — sequence/context parallel (ring attention axis)
+  tp — tensor parallel (the reference's nSlices axis)
+
+Multi-host TPU slices work transparently: `jax.devices()` spans hosts and
+GSPMD collectives ride ICI/DCN — the replacement for the reference's
+socket star (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+
+
+def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Build a (dp, sp, tp) mesh. tp defaults to all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp is None:
+        assert n % (dp * sp) == 0, (n, dp, sp)
+        tp = n // (dp * sp)
+    need = dp * sp * tp
+    assert need <= n, f"mesh {dp}x{sp}x{tp} needs {need} devices, have {n}"
+    arr = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))

@@ -1,0 +1,129 @@
+"""Partition specs reproducing the reference's tensor-parallel decomposition.
+
+The reference splits weights two ways (ref: src/transformer.cpp:14-76):
+
+  RowMatmulSlice — output-dim split: wq, wk, wv, w1, w3, MoE up/gate/down
+  ColMatmulSlice — input-dim split (partial sums reduced at root): wo, w2
+
+Here the same decomposition is a PartitionSpec per tensor; GSPMD turns the
+col-split contractions into psum/reduce-scatter over ICI — the reference's
+gather+sum-at-root (ref: src/tasks.cpp:67-90, llama2-tasks.cpp:125-131)
+with the star topology replaced by all-reduce.
+
+Unsliced tensors (embeddings, norms, router — the reference's root-only set,
+ref: src/transformer.cpp:639-673) are replicated. wcls is vocab-sharded (an
+improvement: the reference computes all logits on root).
+
+The reference's `nSlices <= nKvHeads` constraint (ref:
+src/transformer.cpp:254-257) becomes `n_kv_heads % tp == 0` here; KV-cache
+heads shard on tp exactly like KvCacheSlice (ref: src/transformer.cpp:161-171).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.spec import ModelSpec
+from ..quants.jax_codec import QuantizedTensor
+from .mesh import DP_AXIS, TP_AXIS
+
+# per-param logical split: 'row' = shard output dim, 'col' = shard input dim,
+# None = replicate. Axis positions account for the leading stacking dims.
+_SPLIT = {
+    "tok_emb": None,
+    "rms_att": None,
+    "rms_ffn": None,
+    "rms_moe": None,
+    "rms_ffn2": None,
+    "rms_final": None,
+    "moe_router": None,
+    "wq": "row",
+    "wk": "row",
+    "wv": "row",
+    "w1": "row",
+    "w3": "row",
+    "moe_up": "row",
+    "moe_gate": "row",
+    "moe_down": "col",
+    "wo": "col",
+    "w2": "col",
+    "wcls": "row",  # vocab-sharded logits (net-new vs reference root-only wcls)
+}
+
+
+def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
+    """PartitionSpec for one array leaf.
+
+    Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, nb, 16)
+    and scales (lead..., d, nb): the n/col split maps onto the block axis nb
+    (blocks are 32 wide; any tp shard of nb keeps whole blocks).
+    """
+    split = _SPLIT[name]
+    axes: list = [None] * ndim
+    if split is None:
+        return P(*axes)
+    if quantized:
+        # packed: (..., d, nb, 16) ; scales: (..., d, nb)
+        d_axis = ndim - 3 if which == "packed" else ndim - 2
+        nb_axis = ndim - 2 if which == "packed" else ndim - 1
+    else:
+        d_axis = ndim - 2
+        nb_axis = ndim - 1
+    axes[d_axis if split == "row" else nb_axis] = TP_AXIS
+    return P(*axes)
+
+
+def param_pspecs(params: dict) -> dict:
+    """Pytree of PartitionSpecs matching the params pytree."""
+    out = {}
+    for name, w in params.items():
+        if isinstance(w, QuantizedTensor):
+            out[name] = QuantizedTensor(  # pytree-shaped specs
+                _pspec_for(name, w.packed.ndim, True, "packed"),
+                _pspec_for(name, w.scales.ndim, True, "scales"),
+            )
+        else:
+            out[name] = _pspec_for(name, w.ndim, False, "dense")
+    return out
+
+
+def cache_pspec() -> P:
+    """KV cache (L, B, S, KVH, hs): batch on dp, kv-heads on tp
+    (ref: KvCacheSlice, src/transformer.cpp:161-171)."""
+    return P(None, DP_AXIS, None, TP_AXIS, None)
+
+
+def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:
+    """Divisibility rules; the reference asserts the same invariants
+    (ref: src/transformer.cpp:15,49,254-257,78-96)."""
+    if tp == 1:
+        return
+    assert spec.n_kv_heads % tp == 0, (
+        f"tp={tp} must divide n_kv_heads={spec.n_kv_heads} "
+        "(reference constraint nSlices <= nKvHeads, transformer.cpp:254-257)")
+    assert spec.n_heads % tp == 0
+    assert spec.hidden_dim % tp == 0 and spec.dim % tp == 0
+    if q40:
+        # col-split shards must keep whole 32-value blocks
+        assert spec.hidden_dim % (32 * tp) == 0
+        assert spec.dim % (32 * tp) == 0
+
+
+def shard_params(params: dict, mesh) -> dict:
+    """device_put every leaf with its NamedSharding (sharded weight placement —
+    the analogue of the reference's per-worker weight push at load,
+    ref: src/transformer.cpp:562-591)."""
+    specs = param_pspecs(params)
+
+    def put(w, s):
+        return jax.device_put(w, NamedSharding(mesh, s))
+
+    out = {}
+    for name, w in params.items():
+        sp = specs[name]
+        if isinstance(w, QuantizedTensor):
+            out[name] = QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))
+        else:
+            out[name] = put(w, sp)
+    return out

@@ -1,0 +1,4 @@
+from .engine import Engine, GenerationResult
+from .stats import StepStats
+
+__all__ = ["Engine", "GenerationResult", "StepStats"]

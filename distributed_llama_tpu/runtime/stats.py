@@ -1,0 +1,38 @@
+"""Per-step timing stats.
+
+Parity with the reference's benchmark surface: per-token G/I/T lines and
+end-of-run averages (ref: src/apps/dllama/dllama.cpp:47-91, tasks.cpp:212-215,
+socket.cpp:266-271). On TPU the compute/transfer split inside one jitted step
+is XLA's business, so we report: generation wall ms (G), device-step ms (I,
+the blocking device time), and host overhead ms (sampling + bookkeeping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class StepStats:
+    generation_ms: float = 0.0  # wall time of the whole token step (G)
+    device_ms: float = 0.0      # device execution (I — inference)
+    host_ms: float = 0.0        # host-side sampling/bookkeeping
+
+
+@dataclasses.dataclass
+class RunStats:
+    steps: list[StepStats] = dataclasses.field(default_factory=list)
+
+    def add(self, s: StepStats) -> None:
+        self.steps.append(s)
+
+    def averages(self, skip_first: int = 1) -> StepStats:
+        """Average over steps, skipping warmup/compile steps (the reference
+        averages all 16 samples; we exclude the compile step)."""
+        body = self.steps[skip_first:] or self.steps
+        n = len(body)
+        return StepStats(
+            generation_ms=sum(s.generation_ms for s in body) / n,
+            device_ms=sum(s.device_ms for s in body) / n,
+            host_ms=sum(s.host_ms for s in body) / n,
+        )

@@ -1,0 +1,165 @@
+"""Tensor-parallel sharding tests on a virtual 8-device CPU mesh.
+
+Closes the reference's testing gap — it has NO automated multi-node test
+(SURVEY.md §4); slicing was only checked shard-by-shard in-process
+(ref: src/transformer-test.cpp:21-72). Here the real SPMD program runs on 8
+XLA devices and must match the single-device result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.models.params import load_params, random_tensors
+from distributed_llama_tpu.models.transformer import KVCache, forward
+from distributed_llama_tpu.parallel import (
+    make_mesh,
+    param_pspecs,
+    q80_psum,
+    shard_params,
+)
+from distributed_llama_tpu.quants import QuantizedTensor
+from distributed_llama_tpu.runtime import Engine
+from distributed_llama_tpu.sampler import Sampler
+
+from test_model_forward import make_spec, dense_weights
+
+
+def test_mesh_axes():
+    mesh = make_mesh(tp=4, dp=2)
+    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+
+
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
+@pytest.mark.parametrize("mode", ["dense", "q40"])
+def test_tp_forward_matches_single_device(arch, mode):
+    # q40 col-splits must keep whole 32-blocks per shard: dim >= 32*tp
+    spec = make_spec(arch, dim=128, n_heads=8, n_kv_heads=4, hidden_dim=256)
+    host, _ = dense_weights(spec, seed=5)
+    params = load_params(spec, host, mode=mode, dtype=jnp.float32)
+
+    tok = jnp.array([[7]], jnp.int32)
+    ref_logits, _ = forward(params, spec, tok, jnp.int32(0), KVCache.create(spec, 1))
+
+    mesh = make_mesh(tp=4, dp=1)
+    engine = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                    cache_dtype=jnp.float32)
+    got = engine.step(np.array([[7]], np.int32), 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_logits), rtol=0, atol=2e-4)
+
+
+def test_tp_multi_step_decode_matches():
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=6)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+
+    toks = [3, 9, 27, 81]
+    # single device
+    cache = KVCache.create(spec, 1)
+    ref = []
+    for i, t in enumerate(toks):
+        lg, cache = forward(params, spec, jnp.array([[t]], jnp.int32), jnp.int32(i), cache)
+        ref.append(np.asarray(lg))
+    # 4-way TP (tp must divide n_kv_heads=4, the reference's nSlices rule)
+    mesh = make_mesh(tp=4)
+    engine = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                    cache_dtype=jnp.float32)
+    for i, t in enumerate(toks):
+        got = engine.step(np.array([[t]], np.int32), i)
+        np.testing.assert_allclose(np.asarray(got), ref[i], rtol=0, atol=5e-4)
+
+
+def test_dp_tp_mesh_runs():
+    """2-way data parallel x 4-way tensor parallel, batch=2."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=7)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    mesh = make_mesh(tp=4, dp=2)
+    engine = Engine(spec, params, mesh, batch=2, compute_dtype=jnp.float32,
+                    cache_dtype=jnp.float32)
+    logits = engine.step(np.array([[5], [11]], np.int32), 0)
+    assert logits.shape == (2, spec.vocab_size)
+    # row 0 must equal a single-device run of token 5
+    ref, _ = forward(params, spec, jnp.array([[5]], jnp.int32), jnp.int32(0),
+                     KVCache.create(spec, 1))
+    np.testing.assert_allclose(np.asarray(logits)[0], np.asarray(ref)[0], rtol=0, atol=5e-4)
+
+
+def test_param_pspecs_cover_all_leaves():
+    spec = make_spec(ArchType.GROK1)
+    host, _ = dense_weights(spec, seed=8)
+    for mode in ("dense", "q40"):
+        params = load_params(spec, host, mode=mode)
+        specs = param_pspecs(params)
+        assert set(specs) == set(params)
+        for name, w in params.items():
+            if isinstance(w, QuantizedTensor):
+                assert len(specs[name].packed) == w.packed.ndim
+                assert len(specs[name].scales) == w.scales.ndim
+            else:
+                assert len(specs[name]) == w.ndim
+
+
+def test_q80_psum_matches_psum():
+    """Quantized all-reduce ~ exact all-reduce (the reference's Q80 wire,
+    ref: src/tasks.cpp:124-163)."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = make_mesh(tp=8)
+    x = np.random.default_rng(0).standard_normal((8, 4, 64)).astype(np.float32)
+
+    @jax.jit
+    def exact(x):
+        f = shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+                      in_specs=P("tp"), out_specs=P(), check_rep=False)
+        return f(x)
+
+    @jax.jit
+    def quantized(x):
+        f = shard_map(lambda v: q80_psum(v[0], "tp")[None], mesh=mesh,
+                      in_specs=P("tp"), out_specs=P(), check_rep=False)
+        return f(x)
+
+    a = np.asarray(exact(x))
+    b = np.asarray(quantized(x))
+    # int8 blocks: small relative error on the reduced values
+    assert np.abs(a - b).max() < 8 * np.abs(x).max() / 127 * 1.1
+
+
+def test_engine_generate_greedy():
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=9)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    mesh = make_mesh(tp=2)
+    engine = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                    cache_dtype=jnp.float32, prefill_chunk=4)
+    sampler = Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+    result = engine.generate([1, 5, 9], max_tokens=5, sampler=sampler)
+    assert len(result.tokens) == 5
+    # greedy is deterministic: same prompt, same continuation
+    engine.reset()
+    result2 = engine.generate([1, 5, 9], max_tokens=5, sampler=sampler)
+    assert result.tokens == result2.tokens
+    avg = result.stats.averages()
+    assert avg.generation_ms > 0
+
+
+def test_device_greedy_decode_matches_host_loop():
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=10)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+
+    engine = Engine(spec, params, mesh=None, compute_dtype=jnp.float32,
+                    cache_dtype=jnp.float32)
+    toks_dev, _ = engine.decode_greedy_device(first_token=3, n_tokens=6)
+
+    engine2 = Engine(spec, params, mesh=None, compute_dtype=jnp.float32,
+                     cache_dtype=jnp.float32)
+    sampler = Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+    res = engine2.generate([3], max_tokens=7, sampler=sampler)
+    # device loop emits argmax AFTER consuming token i; host loop's first
+    # output corresponds to the same position
+    assert list(toks_dev.reshape(-1)[:6]) == res.tokens[:6]
