@@ -1,0 +1,98 @@
+"""Benchmark: Llama-2-7B Q40 decode ms/token on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`vs_baseline` is the speedup factor over the reference's best published
+single-node Llama-2-7B number (101.81 ms/token on a 30-vCPU GCP c3d VM,
+ref: README.md:88; the RasPi-5 single-node figure is 441.09 ms/token).
+
+Weights are synthetic Q40 blocks generated at the packed-byte level (random
+nibbles + small f16 scales) — decode speed does not depend on weight values,
+and this avoids materializing 28 GB of f32 on the host. The decode path is
+the production one: Engine.decode_greedy_device (fully on-device lax.scan,
+fused argmax, donated KV cache).
+
+Env knobs: BENCH_MODEL=7b|tiny, BENCH_TOKENS=<n decode steps>.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
+from distributed_llama_tpu.runtime.engine import Engine
+
+BASELINE_MS_PER_TOKEN = 101.81  # ref README.md:88 — Llama 2 7B, 1x GCP c3d-highcpu-30
+
+LLAMA2_7B = ModelSpec(
+    arch=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
+    n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
+    hidden_act=HiddenAct.SILU)
+
+TINY = ModelSpec(
+    arch=ArchType.LLAMA, dim=256, hidden_dim=704, n_layers=4,
+    n_heads=8, n_kv_heads=8, vocab_size=512, seq_len=256,
+    hidden_act=HiddenAct.SILU)
+
+
+def _rand_q40(rng: np.random.Generator, *shape: int) -> QuantizedTensor:
+    """Random Q40 weight of logical shape (..., n): packed nibbles + scales
+    sized so dequantized values land in a healthy ~N(0, 0.02) range."""
+    nb = shape[-1] // 32
+    packed = rng.integers(0, 256, (*shape[:-1], nb, 16), dtype=np.uint8)
+    scales = (rng.random((*shape[:-1], nb), dtype=np.float32) * 0.004 + 0.001)
+    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales.astype(np.float16)))
+
+
+def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict:
+    rng = np.random.default_rng(seed)
+    L, d, h = spec.n_layers, spec.dim, spec.hidden_dim
+    kv = spec.kv_dim
+    p = {
+        "tok_emb": jnp.asarray(
+            rng.standard_normal((spec.vocab_size, d), dtype=np.float32) * 0.02, dtype),
+        "rms_att": jnp.ones((L, d), jnp.float32),
+        "rms_ffn": jnp.ones((L, d), jnp.float32),
+        "rms_final": jnp.ones((d,), jnp.float32),
+        "wq": _rand_q40(rng, L, d, d),
+        "wk": _rand_q40(rng, L, kv, d),
+        "wv": _rand_q40(rng, L, kv, d),
+        "wo": _rand_q40(rng, L, d, d),
+        "w1": _rand_q40(rng, L, h, d),
+        "w2": _rand_q40(rng, L, d, h),
+        "w3": _rand_q40(rng, L, h, d),
+        "wcls": _rand_q40(rng, 1, spec.vocab_size, d),
+    }
+    return p
+
+
+def main() -> None:
+    model = os.environ.get("BENCH_MODEL", "7b")
+    n_tokens = int(os.environ.get("BENCH_TOKENS", "64"))
+    spec = LLAMA2_7B if model == "7b" else TINY
+
+    params = synth_q40_params(spec)
+    engine = Engine(
+        spec, params,
+        compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
+        max_seq_len=min(spec.seq_len, 2048))
+
+    _, dt = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
+    ms_per_token = dt / n_tokens * 1e3
+
+    print(json.dumps({
+        "metric": f"llama2_7b_q40_decode_ms_per_token_1chip" if model == "7b"
+                  else "tiny_llama_q40_decode_ms_per_token",
+        "value": round(ms_per_token, 3),
+        "unit": "ms/token",
+        "vs_baseline": round(BASELINE_MS_PER_TOKEN / ms_per_token, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,11 +156,11 @@ class Engine:
 
         t0 = time.perf_counter()
         logits = self.prefill(prompt)
-        jax.block_until_ready(logits)
+        logits_np = np.asarray(logits)  # device->host transfer is the only true sync on tunneled platforms
         t1 = time.perf_counter()
         stats.add(StepStats(generation_ms=(t1 - t0) * 1e3, device_ms=(t1 - t0) * 1e3))
 
-        token = sampler.sample(np.asarray(logits)[0])
+        token = sampler.sample(logits_np[0])
         out.append(token)
         if on_token:
             on_token(token)
@@ -170,9 +170,9 @@ class Engine:
                 break
             g0 = time.perf_counter()
             logits = self.step(np.asarray([[token]], np.int32), self.pos)
-            jax.block_until_ready(logits)
+            logits_np = np.asarray(logits)
             g1 = time.perf_counter()
-            token = sampler.sample(np.asarray(logits)[0])
+            token = sampler.sample(logits_np[0])
             g2 = time.perf_counter()
             stats.add(StepStats(
                 generation_ms=(g2 - g0) * 1e3,
@@ -218,12 +218,15 @@ class Engine:
         # compile + warm (excluded from timing); caches are donated, so each
         # call gets a fresh one
         toks, _ = run(self.params, tok0, pos0, self._new_cache())
-        jax.block_until_ready(toks)
+        _ = np.asarray(toks)  # sync via D2H transfer
 
         t0 = time.perf_counter()
         toks, cache = run(self.params, tok0, pos0, self._new_cache())
-        jax.block_until_ready(toks)
+        # the host transfer is the sync point: toks depends on every decode
+        # step, and block_until_ready returns early (measured: impossible
+        # sub-HBM-bandwidth timings) on the tunneled axon TPU platform
+        toks_np = np.asarray(toks)
         dt = time.perf_counter() - t0
         self.cache = cache
         self.pos += n_tokens
-        return np.asarray(toks), dt
+        return toks_np, dt

@@ -15,7 +15,9 @@ import dataclasses
 @dataclasses.dataclass
 class StepStats:
     generation_ms: float = 0.0  # wall time of the whole token step (G)
-    device_ms: float = 0.0      # device execution (I — inference)
+    device_ms: float = 0.0      # device execution + logits D2H transfer (I) —
+                                # the transfer is the sync point, so it cannot
+                                # be separated from device time
     host_ms: float = 0.0        # host-side sampling/bookkeeping
 
 
