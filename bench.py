@@ -42,9 +42,10 @@ TINY = ModelSpec(
 
 def _rand_q40(rng: np.random.Generator, *shape: int) -> QuantizedTensor:
     """Random Q40 weight of logical shape (..., n): packed nibbles + scales
-    sized so dequantized values land in a healthy ~N(0, 0.02) range."""
+    sized so dequantized values land in a healthy ~N(0, 0.02) range.
+    Generated directly in the device layout (..., 16, nb)."""
     nb = shape[-1] // 32
-    packed = rng.integers(0, 256, (*shape[:-1], nb, 16), dtype=np.uint8)
+    packed = rng.integers(0, 256, (*shape[:-1], 16, nb), dtype=np.uint8)
     scales = (rng.random((*shape[:-1], nb), dtype=np.float32) * 0.004 + 0.001)
     return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales.astype(np.float16)))
 

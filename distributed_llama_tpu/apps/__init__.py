@@ -1,0 +1,2 @@
+"""User-facing apps: dllama CLI and the OpenAI-compatible API server
+(TPU-native equivalents of ref: src/apps/dllama, src/apps/dllama-api)."""

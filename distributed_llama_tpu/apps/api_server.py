@@ -1,0 +1,234 @@
+"""OpenAI-compatible HTTP API server.
+
+TPU-native equivalent of the reference's dllama-api
+(ref: src/apps/dllama-api/dllama-api.cpp):
+
+  * POST /v1/chat/completions — completion + SSE streaming
+    (ref: dllama-api.cpp:202-314)
+  * GET /v1/models (ref: dllama-api.cpp:316-322)
+  * Llama-3 header chat template (ref: dllama-api.cpp:173-181)
+  * per-request temperature / seed / max_tokens / stop
+    (ref: dllama-api.cpp:211-232), applied via Sampler setters
+    (ref: src/tokenizer.cpp:358-364)
+  * stop-sequence scan over the trailing pieces (ref: dllama-api.cpp:272-286)
+  * stateless sessions: KV cache/pos reset per request (ref: dllama-api.cpp:236-249)
+
+Single-threaded accept loop like the reference (ref: dllama-api.cpp:341-352);
+stdlib http.server, no external deps.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+
+CHAT_EOS_MARKERS = ("<|eot_id|>", "<|end_of_text|>")
+
+
+class PromptTooLong(ValueError):
+    pass
+
+
+def build_chat_prompt(messages: list[dict]) -> str:
+    """Llama-3 header template (ref: dllama-api.cpp:173-181)."""
+    out = []
+    for m in messages:
+        out.append(f"<|start_header_id|>{m.get('role', 'user')}<|end_header_id|>\n\n"
+                   f"{m.get('content', '')}<|eot_id|>")
+    out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    return "".join(out)
+
+
+class ApiState:
+    def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama"):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.sampler = sampler
+        self.model_name = model_name
+
+
+def _completion_chunks(state: ApiState, body: dict):
+    """Generator of generated text pieces for one request."""
+    engine, tokenizer, sampler = state.engine, state.tokenizer, state.sampler
+
+    messages = body.get("messages", [])
+    prompt = build_chat_prompt(messages)
+    max_tokens = int(body.get("max_tokens", 0) or 0)
+    stops = body.get("stop") or []
+    if isinstance(stops, str):
+        stops = [stops]
+
+    engine.reset()  # stateless per request (ref: dllama-api.cpp:236-249)
+    tokens = tokenizer.encode(prompt)
+    if len(tokens) >= engine.seq_len:
+        raise PromptTooLong(
+            f"prompt is {len(tokens)} tokens; context is {engine.seq_len}")
+
+    # per-request sampler params must not leak into later requests that omit
+    # them — the server default is restored in the finally below
+    saved_temp = sampler.temperature
+    if body.get("temperature") is not None:
+        sampler.set_temp(float(body["temperature"]))
+    if body.get("seed") is not None:
+        sampler.set_seed(int(body["seed"]))
+
+    limit = engine.seq_len - len(tokens) - 1
+    n_gen = min(max_tokens, limit) if max_tokens > 0 else limit
+
+    prev = tokens[-1]
+    n_prompt = len(tokens)
+    tail = ""  # bounded scan window for markers/stop sequences
+    tail_len = max([len(m) for m in CHAT_EOS_MARKERS]
+                   + [len(s) for s in stops] + [1]) + 16
+    emitted = 0
+    finish = "length"
+    try:
+        logits = engine.prefill(tokens)
+        for _ in range(n_gen):
+            tok = sampler.sample(np.asarray(logits)[0])
+            if tok == tokenizer.eos_id:
+                finish = "stop"
+                break
+            piece = tokenizer.decode_piece(prev, tok).decode("utf-8", errors="replace")
+            prev = tok
+            tail = (tail + piece)[-tail_len:]
+            if any(m in tail for m in CHAT_EOS_MARKERS):
+                finish = "stop"
+                break
+            # stop-sequence scan over the trailing window (ref: dllama-api.cpp:272-286)
+            if stops and any(s in tail for s in stops):
+                finish = "stop"
+                break
+            emitted += 1
+            yield ("piece", piece)
+            if engine.pos >= engine.seq_len:
+                break
+            logits = engine.step(np.asarray([[tok]], np.int32), engine.pos)
+    finally:
+        sampler.set_temp(saved_temp)
+    yield ("done", {"finish_reason": finish,
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": emitted})
+
+
+def make_handler(state: ApiState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *fargs):  # quiet
+            pass
+
+        def _json(self, code: int, obj: dict) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                # ref: dllama-api.cpp:316-322
+                self._json(200, {"object": "list", "data": [
+                    {"id": state.model_name, "object": "model",
+                     "created": int(time.time()), "owned_by": "user"}]})
+            elif self.path in ("/", "/health"):
+                self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._json(400, {"error": "bad request"})
+                return
+
+            rid = f"chatcmpl-{int(time.time()*1000):x}"
+            created = int(time.time())
+            stream = bool(body.get("stream", False))
+
+            # pull the first event before committing a 200 so prompt errors
+            # can still return a clean 4xx
+            gen = _completion_chunks(state, body)
+            try:
+                first = next(gen)
+            except PromptTooLong as e:
+                self._json(400, {"error": str(e)})
+                return
+
+            def events():
+                yield first
+                yield from gen
+
+            if stream:
+                # SSE chunked streaming (ref: dllama-api.cpp:125-145,183-200)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def sse(obj):
+                    self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+                    self.wfile.flush()
+
+                usage = None
+                for kind, payload in events():
+                    if kind == "piece":
+                        sse({"id": rid, "object": "chat.completion.chunk",
+                             "created": created, "model": state.model_name,
+                             "choices": [{"index": 0,
+                                          "delta": {"content": payload},
+                                          "finish_reason": None}]})
+                    else:
+                        usage = payload
+                sse({"id": rid, "object": "chat.completion.chunk",
+                     "created": created, "model": state.model_name,
+                     "choices": [{"index": 0, "delta": {},
+                                  "finish_reason": usage["finish_reason"]}]})
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+                return
+
+            text = ""
+            usage = {"finish_reason": "length", "prompt_tokens": 0, "completion_tokens": 0}
+            for kind, payload in events():
+                if kind == "piece":
+                    text += payload
+                else:
+                    usage = payload
+            # OpenAI-shaped response + usage (ref: types.hpp:10-91)
+            self._json(200, {
+                "id": rid, "object": "chat.completion", "created": created,
+                "model": state.model_name,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant", "content": text},
+                             "finish_reason": usage["finish_reason"]}],
+                "usage": {
+                    "prompt_tokens": usage["prompt_tokens"],
+                    "completion_tokens": usage["completion_tokens"],
+                    "total_tokens": usage["prompt_tokens"] + usage["completion_tokens"],
+                }})
+
+    return Handler
+
+
+def serve(args) -> None:
+    from .dllama import build_engine
+
+    engine, tokenizer, sampler = build_engine(args)
+    state = ApiState(engine, tokenizer, sampler)
+    server = HTTPServer((args.host, args.port), make_handler(state))
+    print(f"🔌 dllama-api listening on {args.host}:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.server_close()

@@ -1,0 +1,224 @@
+"""dllama CLI — inference / generate / chat modes.
+
+TPU-native equivalent of the reference CLI (ref: src/apps/dllama/dllama.cpp):
+
+  inference  prompt completion with a per-token benchmark line and end-of-run
+             averages (ref: dllama.cpp:43-91)
+  generate   plain streaming completion (ref: dllama.cpp:96-131)
+  chat       interactive chat with the Llama-2 [INST]/<<SYS>> template
+             (ref: dllama.cpp:133-178)
+  api        OpenAI-compatible HTTP server (ref: src/apps/dllama-api)
+  worker     n/a — the reference's root/worker TCP star is replaced by one
+             SPMD program over a jax Mesh; use --tp N instead
+             (ref: dllama.cpp:180-193, SURVEY.md §5.8)
+
+Flag surface mirrors AppArgs::parse (ref: src/app.cpp:19-93) plus TPU mesh
+flags. --weights-float-type / --buffer-float-type keep the reference
+semantics: the former must match the model file, the latter selects the Q80
+activation round-trip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllama",
+        description="TPU-native distributed-llama: run Llama/Mixtral/Grok-1 "
+                    "inference from reference-format .m/.t files.")
+    p.add_argument("mode", choices=["inference", "generate", "chat", "api", "worker"])
+    p.add_argument("--model", help="path to .m model file")
+    p.add_argument("--tokenizer", help="path to .t tokenizer file")
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=0,
+                   help="max tokens to generate (0 = until seq_len, ref app.cpp:117-119)")
+    p.add_argument("--temperature", type=float, default=0.8)  # ref: app.cpp:31
+    p.add_argument("--topp", type=float, default=0.9)         # ref: app.cpp:32
+    p.add_argument("--seed", type=int, default=None,
+                   help="sampler seed (default: time, ref app.cpp:88-91)")
+    p.add_argument("--weights-float-type", default=None,
+                   choices=["f32", "f16", "q40", "q80"],
+                   help="must match the model file (ref: app.cpp:47-48)")
+    p.add_argument("--buffer-float-type", default="q80", choices=["f32", "q80"],
+                   help="activation exchange dtype (q80 reproduces the "
+                        "reference's quantized wire buffers, ref: app.cpp:49-50)")
+    p.add_argument("--nthreads", type=int, default=None,
+                   help="accepted for reference CLI parity; XLA manages "
+                        "device parallelism (ref: app.cpp:84)")
+    p.add_argument("--workers", nargs="*", default=None,
+                   help="n/a on TPU; use --tp (ref: app.cpp:51-74)")
+    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--host", default="0.0.0.0")
+    # TPU-native flags
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh size")
+    p.add_argument("--dp", type=int, default=1, help="data-parallel mesh size")
+    p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--cache-dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--pallas", action="store_true",
+                   help="opt into the fused Q40 Pallas kernel (default: XLA "
+                        "dequant path, which currently measures at parity)")
+    p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
+    return p
+
+
+def build_engine(args):
+    """model file -> (engine, tokenizer, sampler). Mirrors App::run wiring
+    (ref: src/app.cpp:103-132)."""
+    import jax.numpy as jnp
+
+    from ..io.model_file import read_model
+    from ..models.params import load_params
+    from ..quants.types import FloatType
+    from ..runtime.engine import Engine
+    from ..sampler import Sampler
+    from ..tokenizer import Tokenizer
+
+    if not args.model or not args.tokenizer:
+        sys.exit("error: --model and --tokenizer are required")
+
+    wft = None
+    if args.weights_float_type:
+        wft = FloatType[args.weights_float_type.upper()]
+
+    t0 = time.time()
+    spec, tensors = read_model(args.model, weights_float_type=wft)
+    print(f"⏩ loaded {args.model}: arch={spec.arch.name} dim={spec.dim} "
+          f"layers={spec.n_layers} heads={spec.n_heads}/{spec.n_kv_heads} "
+          f"seq={spec.seq_len} ({time.time()-t0:.1f}s)")
+
+    mode = "q40" if spec.weights_float_type == FloatType.Q40 else "dense"
+    cdt = jnp.bfloat16 if args.compute_dtype == "bf16" else jnp.float32
+    kdt = jnp.bfloat16 if args.cache_dtype == "bf16" else jnp.float32
+
+    mesh = None
+    if args.tp > 1 or args.dp > 1:
+        from ..parallel.mesh import make_mesh
+        mesh = make_mesh(tp=args.tp, dp=args.dp)
+
+    params = load_params(spec, tensors, mode=mode, dtype=cdt)
+    engine = Engine(
+        spec, params, mesh,
+        batch=max(args.dp, 1),
+        max_seq_len=args.max_seq_len,
+        compute_dtype=cdt, cache_dtype=kdt,
+        activation_q80=(args.buffer_float_type == "q80" and mode == "q40"),
+        use_pallas=bool(args.pallas),
+    )
+
+    tokenizer = Tokenizer.from_file(args.tokenizer)
+    seed = args.seed if args.seed is not None else int(time.time())
+    sampler = Sampler(tokenizer.vocab_size, args.temperature, args.topp, seed)
+    return engine, tokenizer, sampler
+
+
+def _steps(args, engine) -> int:
+    s = args.steps if args.steps > 0 else engine.seq_len
+    return min(s, engine.seq_len)  # clamp like ref: app.cpp:117-119
+
+
+def _safe_print(piece: str) -> None:
+    """Print only printable pieces (ref: safePrintf, src/tokenizer.cpp:18-36)."""
+    out = "".join(c for c in piece if c.isprintable() or c in "\n\t ")
+    print(out, end="", flush=True)
+
+
+def cmd_generate(args, benchmark: bool) -> None:
+    engine, tokenizer, sampler = build_engine(args)
+    prompt = args.prompt or "Hello"
+    tokens = tokenizer.encode(prompt)
+    print(f"💡 prompt tokens: {len(tokens)}")
+
+    prev = [tokens[-1]]
+
+    def on_token(tok: int) -> None:
+        _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
+        prev[0] = tok
+
+    res = engine.generate(tokens, _steps(args, engine), sampler,
+                          eos_id=tokenizer.eos_id, on_token=on_token)
+    print()
+    if benchmark:
+        # per-token G/I lines + averages (ref: dllama.cpp:47-48,74-91)
+        for i, s in enumerate(res.stats.steps):
+            print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
+                  f"H {s.host_ms:5.2f} ms")
+        avg = res.stats.averages()
+        n = len(res.tokens)
+        print(f"Generated tokens:    {n}")
+        print(f"Avg tokens / second: {1000.0 / max(avg.generation_ms, 1e-9):.2f}")
+        print(f"Avg generation time: {avg.generation_ms:.2f} ms")
+        print(f"Avg inference time:  {avg.device_ms:.2f} ms")
+        print(f"Avg sampling time:   {avg.host_ms:.2f} ms")
+
+
+def cmd_chat(args) -> None:
+    """Interactive chat with the Llama-2 template (ref: dllama.cpp:133-178)."""
+    engine, tokenizer, sampler = build_engine(args)
+    system = args.system_prompt
+    if system is None:
+        try:
+            system = input("💻 System prompt (optional): ")
+        except EOFError:
+            system = ""
+    first = True
+    while True:
+        try:
+            user = input("\n👱 User\n> ")
+        except EOFError:
+            break
+        if not user:
+            continue
+        if first and system:
+            text = f"[INST] <<SYS>>\n{system}\n<</SYS>>\n\n{user} [/INST]"
+        else:
+            text = f"[INST] {user} [/INST]"
+        first = False
+        tokens = tokenizer.encode(text, add_bos=True)
+        print("\n🤖 Assistant")
+        prev = [tokens[-1]]
+
+        def on_token(tok: int) -> None:
+            if tok != tokenizer.eos_id:
+                _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
+            prev[0] = tok
+
+        # the prompt itself must also fit before any generation can start
+        remaining = engine.seq_len - engine.pos - len(tokens)
+        if remaining <= 1:
+            print("(context window full)")
+            break
+        engine.generate(tokens, min(_steps(args, engine), remaining), sampler,
+                        eos_id=tokenizer.eos_id, on_token=on_token)
+        print()
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_argparser().parse_args(argv)
+    if args.workers:
+        sys.exit("error: --workers is not applicable on TPU — the reference's "
+                 "TCP root/worker star is one SPMD program here; use --tp N "
+                 "to shard over N devices (SURVEY.md §5.8)")
+    if args.mode == "worker":
+        sys.exit("error: worker mode is not applicable on TPU — run a single "
+                 "process with --tp N over the device mesh instead")
+    if args.mode == "inference":
+        cmd_generate(args, benchmark=True)
+    elif args.mode == "generate":
+        cmd_generate(args, benchmark=False)
+    elif args.mode == "chat":
+        cmd_chat(args)
+    elif args.mode == "api":
+        from .api_server import serve
+        serve(args)
+
+
+if __name__ == "__main__":
+    main()

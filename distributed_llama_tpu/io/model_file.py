@@ -159,6 +159,12 @@ def read_spec(path: str, weights_float_type: FloatType | None = None) -> ModelSp
     wt = weights_float_type
     if wt is None:
         wt = FloatType(file_wt) if file_wt is not None else FloatType.F32
+    elif file_wt is not None and int(wt) != file_wt:
+        # the reference requires the flag to match the file (ref: app.cpp:47-48)
+        # but fails mid-load; fail fast with a clear message instead
+        raise ValueError(
+            f"--weights-float-type {wt.name} does not match the model file "
+            f"header ({FloatType(file_wt).name})")
     spec = ModelSpec(
         arch=ArchType(fields["arch_type"]),
         dim=fields["dim"],

@@ -32,7 +32,7 @@ from .spec import ArchType, ModelSpec
 def _stack_q40(tensors: list[HostTensor]) -> QuantizedTensor:
     packed = np.stack([t.packed for t in tensors])
     scales = np.stack([t.scales for t in tensors])
-    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
+    return QuantizedTensor.from_numpy(scales, packed)
 
 
 def _to_q40_host(x: np.ndarray) -> HostTensor:
@@ -69,7 +69,7 @@ def load_params(
                     qs.append(_to_q40_host(t.to_f32()))
             packed = np.stack([q.packed for q in qs])
             scales = np.stack([q.scales for q in qs])
-            return dev(shape_hint, QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales)))
+            return dev(shape_hint, QuantizedTensor.from_numpy(scales, packed))
         dense = np.stack([t.to_f32() for t in ts]).astype(dtype)
         return dev(shape_hint, dense)
 
@@ -96,7 +96,7 @@ def load_params(
                 E = spec.n_experts
                 packed = np.stack([q.packed for q in qs]).reshape(L, E, *qs[0].packed.shape)
                 scales = np.stack([q.scales for q in qs]).reshape(L, E, *qs[0].scales.shape)
-                p[f"moe_{w}"] = dev(f"moe_{w}", QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales)))
+                p[f"moe_{w}"] = dev(f"moe_{w}", QuantizedTensor.from_numpy(scales, packed))
             else:
                 dense = np.stack([t.to_f32() for t in ts]).astype(dtype)
                 p[f"moe_{w}"] = dev(f"moe_{w}", dense.reshape(L, spec.n_experts, *dense.shape[1:]))

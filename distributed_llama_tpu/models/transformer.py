@@ -200,12 +200,14 @@ def forward(
     activation_q80: bool = False,
     compute_dtype=jnp.float32,
     logits_for_all: bool = False,
+    use_pallas: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
 
     logits: (B, vocab) for the last token, or (B, T, vocab) if logits_for_all.
     """
-    cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype)
+    cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
+               use_pallas=use_pallas)
     b, t = tokens.shape
 
     x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203

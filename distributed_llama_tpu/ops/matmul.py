@@ -29,12 +29,16 @@ def matmul(
     *,
     activation_q80: bool = False,
     compute_dtype=jnp.float32,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
     activation_q80=True round-trips the activation through Q80 blocks first,
     reproducing the reference's quantized activation buffers
     (ref: src/tasks.cpp:124-148) for bit-accuracy experiments.
+
+    use_pallas=True routes Q40 weights through the fused dequant-matmul TPU
+    kernel (ops/pallas_q40.py) when its layout preconditions hold.
     """
     if activation_q80:
         q, scales = quantize_q80_jax(x)
@@ -43,6 +47,11 @@ def matmul(
         x = x.astype(compute_dtype)
 
     if isinstance(w, QuantizedTensor):
+        if use_pallas:
+            from .pallas_q40 import q40_matmul, supports_pallas
+
+            if supports_pallas(w):
+                return q40_matmul(x, w, out_dtype=compute_dtype)
         wd = dequantize_q40_jax(w, dtype=compute_dtype)
     else:
         wd = w.astype(compute_dtype)

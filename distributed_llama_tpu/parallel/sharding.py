@@ -55,7 +55,7 @@ _SPLIT = {
 def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
     """PartitionSpec for one array leaf.
 
-    Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, nb, 16)
+    Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, 16, nb)
     and scales (lead..., d, nb): the n/col split maps onto the block axis nb
     (blocks are 32 wide; any tp shard of nb keeps whole blocks).
     """
@@ -64,9 +64,9 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
     if split is None:
         return P(*axes)
     if quantized:
-        # packed: (..., d, nb, 16) ; scales: (..., d, nb)
+        # packed: (..., d, 16, nb) ; scales: (..., d, nb)
         d_axis = ndim - 3 if which == "packed" else ndim - 2
-        nb_axis = ndim - 2 if which == "packed" else ndim - 1
+        nb_axis = ndim - 1
     else:
         d_axis = ndim - 2
         nb_axis = ndim - 1

@@ -6,6 +6,13 @@ reference's interleaved 18-byte blocks (ref: src/quants.hpp:16-19) — the
 layout XLA/Pallas can tile: nibble-unpack and scale-multiply fuse into the
 consuming matmul, and both arrays shard cleanly over a mesh axis.
 
+Device layout is nibble-position-major: packed (..., 16, nb) where
+packed[..., j, b] holds byte j of block b — the transpose of the host/file
+block-major order (..., nb, 16). This is chosen for the Pallas kernel
+(ops/pallas_q40.py): flattening gives lane order m = j*nb + b, so the
+per-block scale expansion becomes a lane-tile (pltpu.repeat) instead of an
+element-wise repeat Mosaic cannot lower. `from_numpy` performs the swap.
+
 Numerics match the reference decoder (ref: src/quants.cpp:166-179): value =
 (nibble - 8) * f16_scale, lower nibbles are elements [0,16) of the block and
 upper nibbles are elements [16,32).
@@ -26,7 +33,7 @@ from .types import BLOCK_SIZE
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedTensor:
-    """Q40 tensor of logical shape (..., n): packed (..., n//32, 16) u8 + scales (..., n//32) f16."""
+    """Q40 tensor of logical shape (..., n): packed (..., 16, n//32) u8 + scales (..., n//32) f16."""
 
     packed: jax.Array  # uint8
     scales: jax.Array  # float16
@@ -58,15 +65,18 @@ class QuantizedTensor:
 
     @classmethod
     def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
-        return cls(jnp.asarray(packed), jnp.asarray(scales))
+        """Host block-major packed (..., nb, 16) -> device (..., 16, nb)."""
+        return cls(jnp.asarray(packed.swapaxes(-1, -2)), jnp.asarray(scales))
 
 
 def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
     """Unpack Q40 to a dense array of `dtype` with logical shape t.shape."""
-    lo = (t.packed & 0xF).astype(jnp.int8) - 8
+    lo = (t.packed & 0xF).astype(jnp.int8) - 8   # (..., 16, nb): [j, b]
     hi = (t.packed >> 4).astype(jnp.int8) - 8
-    vals = jnp.concatenate([lo, hi], axis=-1)  # (..., nb, 32)
-    out = vals.astype(dtype) * t.scales[..., None].astype(dtype)
+    vals = jnp.concatenate([lo, hi], axis=-2)    # (..., 32, nb): k = h*16 + j
+    out = vals.astype(dtype) * t.scales[..., None, :].astype(dtype)
+    # dense[..., b*32 + k] = vals[..., k, b]
+    out = jnp.swapaxes(out, -1, -2)
     return out.reshape(*out.shape[:-2], -1)
 
 

@@ -50,6 +50,7 @@ class Engine:
         cache_dtype=jnp.bfloat16,
         activation_q80: bool = False,
         prefill_chunk: int = 128,
+        use_pallas: bool | None = None,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -59,6 +60,12 @@ class Engine:
         self.cache_dtype = cache_dtype
         self.activation_q80 = activation_q80
         self.prefill_chunk = prefill_chunk
+        if use_pallas is None:
+            # default off: measured at parity with the XLA dequant path on the
+            # current chip (decode is MXU-latency-bound at batch=1, so the
+            # packed-HBM-read saving doesn't pay yet) — opt in explicitly
+            use_pallas = False
+        self.use_pallas = use_pallas
 
         if mesh is not None:
             from ..quants.jax_codec import QuantizedTensor
@@ -107,6 +114,7 @@ class Engine:
                 params, self.spec, tokens, pos0, cache,
                 activation_q80=self.activation_q80,
                 compute_dtype=self.compute_dtype,
+                use_pallas=self.use_pallas,
             )
 
         fn = jax.jit(run, donate_argnums=(3,))
@@ -201,6 +209,7 @@ class Engine:
                     params, spec, tok, pos, cache,
                     activation_q80=self.activation_q80,
                     compute_dtype=self.compute_dtype,
+                    use_pallas=self.use_pallas,
                 )
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (nxt[:, None], pos + 1, cache), nxt

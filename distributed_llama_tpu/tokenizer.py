@@ -60,7 +60,10 @@ class Tokenizer:
             if tid is not None:
                 tokens.append(tid)
             else:
-                tokens.extend(b + 3 for b in piece)  # byte fallback, +3 offset
+                # byte fallback, +3 offset; clamp to <unk> (0) if the vocab
+                # has no byte tokens (the reference indexes unchecked)
+                tokens.extend(b + 3 if b + 3 < len(self.vocab) else 0
+                              for b in piece)
             i = j
 
         # greedy merge of the best-scoring adjacent pair (ref: src/tokenizer.cpp:195-223)

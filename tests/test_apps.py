@@ -1,0 +1,135 @@
+"""CLI + API server tests over a tiny end-to-end fixture model.
+
+Exercises the app layer the reference never tested (SURVEY.md §4 notes the
+absence of API-server tests): dllama generate/inference modes and the
+OpenAI-compatible /v1/chat/completions route incl. SSE streaming
+(ref: src/apps/dllama/dllama.cpp, src/apps/dllama-api/dllama-api.cpp).
+"""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.apps import dllama
+from distributed_llama_tpu.apps.api_server import ApiState, make_handler
+from distributed_llama_tpu.io import (
+    TokenizerData, model_tensor_plan, write_model, write_tokenizer_file,
+)
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.quants import FloatType
+
+
+def _fixture(tmp_path, rng, wt=FloatType.Q40):
+    # vocab 288 = 3 specials + 256 byte-fallback tokens + fillers (llama2.c
+    # convention: byte b maps to token b+3)
+    spec = ModelSpec(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, vocab_size=288, seq_len=192, hidden_act=HiddenAct.SILU,
+        weights_float_type=wt)
+    tensors = {
+        name: rng.standard_normal(shape).astype(np.float32) * 0.05
+        for name, shape, _ in model_tensor_plan(spec)
+    }
+    mpath = str(tmp_path / "model.m")
+    write_model(mpath, spec, tensors)
+
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [f"<0x{b:02X}>".encode() for b in range(256)]  # byte-fallback pieces
+    while len(vocab) < spec.vocab_size:
+        vocab.append(f"<fill{len(vocab)}>".encode())
+    scores = [0.0] * len(vocab)
+    tpath = str(tmp_path / "tok.t")
+    write_tokenizer_file(tpath, TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+    return mpath, tpath
+
+
+def test_cli_inference_mode(tmp_path, rng, capsys):
+    mpath, tpath = _fixture(tmp_path, rng)
+    dllama.main([
+        "inference", "--model", mpath, "--tokenizer", tpath,
+        "--prompt", "ab", "--steps", "4", "--seed", "7", "--temperature", "0",
+    ])
+    out = capsys.readouterr().out
+    assert "Generated tokens:    4" in out
+    assert "Avg generation time:" in out
+    assert "🔶 G" in out  # per-token benchmark lines (ref: dllama.cpp:74-79)
+
+
+def test_cli_worker_mode_rejected(tmp_path, rng):
+    with pytest.raises(SystemExit):
+        dllama.main(["worker", "--port", "9998"])
+
+
+@pytest.fixture
+def api_server(tmp_path, rng):
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny")
+    from http.server import HTTPServer
+    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address
+    server.shutdown()
+
+
+def test_api_models_route(api_server):
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/v1/models")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["data"][0]["id"] == "tiny"
+
+
+def test_api_chat_completion(api_server):
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    req = {"messages": [{"role": "user", "content": "ab"}],
+           "max_tokens": 4, "temperature": 0}
+    conn.request("POST", "/v1/chat/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["object"] == "chat.completion"
+    choice = body["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert body["usage"]["completion_tokens"] <= 4
+    assert body["usage"]["total_tokens"] == (
+        body["usage"]["prompt_tokens"] + body["usage"]["completion_tokens"])
+
+
+def test_api_chat_completion_streaming(api_server):
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    req = {"messages": [{"role": "user", "content": "ab"}],
+           "max_tokens": 3, "temperature": 0, "stream": True}
+    conn.request("POST", "/v1/chat/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    deltas = [p["choices"][0]["delta"].get("content", "") for p in parsed[:-1]]
+    assert all(isinstance(d, str) for d in deltas)
+
+
+def test_api_bad_json(api_server):
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("POST", "/v1/chat/completions", "{not json",
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 400

@@ -1,0 +1,56 @@
+"""Fused Q40 Pallas kernel vs the XLA dequant oracle (interpret mode on the
+CPU mesh; the compiled path runs on real TPU via bench/engine opt-in).
+
+The kernel is the TPU-native analogue of the reference's Q40xQ80 SIMD matmul
+(ref: src/funcs.cpp:286-385); correctness target is the dequantize-then-dot
+semantics of the reference decoder (ref: src/quants.cpp:166-179).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.ops.pallas_q40 import q40_matmul, supports_pallas, _tile_d
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor, dequantize_q40_jax
+from distributed_llama_tpu.quants.numpy_codec import quantize_q40
+
+
+def _qt(rng, d, n, scale=0.1):
+    w = rng.standard_normal((d, n), dtype=np.float32) * scale
+    scales, packed = quantize_q40(w)
+    return QuantizedTensor.from_numpy(scales, packed)
+
+
+@pytest.mark.parametrize("d,n,t", [
+    (256, 1024, 1),    # gemv, aligned
+    (256, 1024, 4),    # small batch
+    (704, 128 * 32, 2),  # d not 128-aligned -> whole-d tile
+    (128, 704, 1),     # n/32 not lane-aligned -> full-m block padding
+])
+def test_kernel_matches_dequant_oracle(rng, d, n, t):
+    qt = _qt(rng, d, n)
+    x = jnp.asarray(rng.standard_normal((t, n), dtype=np.float32))
+    ref = jnp.einsum("tn,dn->td", x, dequantize_q40_jax(qt, dtype=jnp.float32))
+    got = q40_matmul(x, qt, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=1e-4)
+
+
+def test_leading_dims_flattened(rng):
+    qt = _qt(rng, 128, 256)
+    x = jnp.asarray(rng.standard_normal((2, 3, 256), dtype=np.float32))
+    got = q40_matmul(x, qt, interpret=True)
+    assert got.shape == (2, 3, 128)
+    ref = jnp.einsum("btn,dn->btd", x, dequantize_q40_jax(qt, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=1e-4)
+
+
+def test_supports_and_tiles():
+    assert _tile_d(4096) == 256
+    assert _tile_d(11008) == 256
+    assert _tile_d(704) == 704     # whole-dim fallback
+    assert _tile_d(32000) == 256
+    rng = np.random.default_rng(0)
+    qt = _qt(rng, 128, 256)
+    assert supports_pallas(qt)
+    stacked = QuantizedTensor(qt.packed[None], qt.scales[None])  # (L, d, 16, nb)
+    assert not supports_pallas(stacked)  # leading dims must be sliced first
