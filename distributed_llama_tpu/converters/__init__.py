@@ -1,0 +1,15 @@
+"""Offline tooling: checkpoint and tokenizer converters.
+
+TPU-native equivalents of the reference converter suite (SURVEY.md §2.4):
+
+  hf.py                 HF safetensors -> .m   (ref: converter/convert-hf.py)
+  meta_llama.py         Meta consolidated.pth -> .m (ref: converter/convert-llama.py)
+  grok1.py              Grok-1 torch bins -> .m (ref: converter/convert-grok-1.py)
+  tokenizer_spm.py      sentencepiece .model -> .t (ref: converter/convert-tokenizer-sentencepiece.py)
+  tokenizer_llama3.py   tiktoken base64 vocab -> .t (ref: converter/convert-tokenizer-llama3.py)
+  download.py           pre-converted model catalog + launcher (ref: download-model.py)
+
+All writers stream tensor-by-tensor through io.model_file.write_header/
+write_tensor in the exact reference file order, so outputs load in both this
+framework and the reference engine.
+"""

@@ -1,0 +1,109 @@
+"""Pre-converted model downloader / launcher.
+
+Equivalent of the reference's download-model.py: a catalog of pre-converted
+`.m`/`.t` files hosted on Hugging Face (the reference publishes these under
+https://huggingface.co/b4rtaz — ref: download-model.py:5-27), downloaded in
+parts and concatenated, then a ready-to-run command is printed
+(ref: download-model.py:55-100).
+
+Usage:
+  python -m distributed_llama_tpu.converters.download tinyllama
+  python -m distributed_llama_tpu.converters.download --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_HF = "https://huggingface.co"
+
+# name -> (model url parts, tokenizer url)  (catalog mirrors download-model.py:5-27)
+CATALOG: dict[str, dict] = {
+    "tinyllama_1_1b_3t_q40": {
+        "model": [f"{_HF}/b4rtaz/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_model_tinylama_1.1b_3t_q40.m?download=true"],
+        "tokenizer": f"{_HF}/b4rtaz/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_tokenizer_tinylama_1.1b_3t.t?download=true",
+    },
+    "llama3_8b_q40": {
+        "model": [f"{_HF}/b4rtaz/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_model_meta-llama-3-8b_q40.m?download=true"],
+        "tokenizer": f"{_HF}/b4rtaz/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+    },
+    "llama3_8b_instruct_q40": {
+        "model": [f"{_HF}/b4rtaz/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_lama3_instruct_q40.m?download=true"],
+        "tokenizer": f"{_HF}/b4rtaz/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+    },
+}
+ALIASES = {"tinyllama": "tinyllama_1_1b_3t_q40", "llama3_8b": "llama3_8b_q40"}
+
+
+def download(url: str, dest: str, progress: bool = True) -> None:
+    def hook(blocks, bs, total):
+        if progress and total > 0 and blocks % 256 == 0:
+            done = min(blocks * bs, total)
+            print(f"\r📥 {dest}: {done / 1e6:.0f}/{total / 1e6:.0f} MB",
+                  end="", flush=True)
+
+    # download to a temp name so an interrupted run never leaves a truncated
+    # file at the final path (the existence check would treat it as complete)
+    tmp = dest + ".download"
+    urllib.request.urlretrieve(url, tmp, reporthook=hook)
+    os.replace(tmp, dest)
+    if progress:
+        print()
+
+
+def fetch_model(name: str, out_dir: str = "models") -> tuple[str, str]:
+    key = ALIASES.get(name, name)
+    if key not in CATALOG:
+        raise KeyError(f"unknown model '{name}' — use --list")
+    entry = CATALOG[key]
+    folder = os.path.join(out_dir, key)
+    os.makedirs(folder, exist_ok=True)
+
+    model_path = os.path.join(folder, f"dllama_model_{key}.m")
+    tok_path = os.path.join(folder, f"dllama_tokenizer_{key}.t")
+
+    if not os.path.exists(model_path):
+        parts = []
+        for i, url in enumerate(entry["model"]):
+            part = model_path + (f".part{i}" if len(entry["model"]) > 1 else "")
+            download(url, part)
+            parts.append(part)
+        if len(parts) > 1:  # concatenate split archives (ref: download-model.py:40-52)
+            with open(model_path, "wb") as out:
+                for part in parts:
+                    with open(part, "rb") as pf:
+                        while chunk := pf.read(1 << 24):
+                            out.write(chunk)
+                    os.remove(part)
+    if not os.path.exists(tok_path):
+        download(entry["tokenizer"], tok_path)
+    return model_path, tok_path
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="Download a pre-converted model")
+    ap.add_argument("name", nargs="?", help="catalog name or alias")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--out-dir", default="models")
+    args = ap.parse_args(argv)
+    if args.list or not args.name:
+        for key in CATALOG:
+            print(key)
+        return
+    try:
+        model, tok = fetch_model(args.name, args.out_dir)
+    except KeyError as e:
+        sys.exit(str(e.args[0]))
+    except (urllib.error.URLError, OSError) as e:
+        sys.exit(f"download failed (no network egress?): {e}")
+    print("✅ downloaded. Run:")
+    print(f"  python -m distributed_llama_tpu.apps.dllama inference "
+          f"--model {model} --tokenizer {tok} --prompt \"Hello world\" --steps 64")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Llama-3 tiktoken vocab -> reference `.t` tokenizer file.
+
+Equivalent of the reference converter (ref:
+converter/convert-tokenizer-llama3.py): the input is the tiktoken text format
+(one `base64token rank` pair per line); merge priority is encoded as a
+negative-rank score so the engine's greedy highest-score merge reproduces BPE
+rank order, and the 256 llama-3 special tokens are appended after the base
+vocab (ref: convert-tokenizer-llama3.py:13-79).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+
+from ..io.tokenizer_file import TokenizerData, write_tokenizer_file
+
+N_SPECIAL = 256
+SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|finetune_right_pad_id|>",
+    "<|step_id|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|eom_id|>",
+    "<|eot_id|>",
+    "<|python_tag|>",
+]
+
+
+def load_tiktoken_vocab(path: str) -> list[bytes]:
+    vocab: list[bytes] = []
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            token_b64, rank = line.split()
+            tok = base64.b64decode(token_b64)
+            assert int(rank) == len(vocab), "ranks must be dense and ordered"
+            vocab.append(tok)
+    return vocab
+
+
+def llama3_to_tokenizer_data(path: str) -> TokenizerData:
+    base = load_tiktoken_vocab(path)
+    specials = list(SPECIAL_TOKENS)
+    specials += [f"<|reserved_special_token_{i}|>"
+                 for i in range(2, 2 + N_SPECIAL - len(specials))]
+    vocab = base + [s.encode() for s in specials]
+    # negative-rank scores: higher-priority merges (lower rank) score higher;
+    # specials get -inf-ish so they never merge
+    scores = [-float(i) for i in range(len(base))]
+    scores += [-1e9] * len(specials)
+    bos = vocab.index(b"<|begin_of_text|>")
+    eos = vocab.index(b"<|eot_id|>")
+    return TokenizerData(vocab=vocab, scores=scores, bos_id=bos, eos_id=eos)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="Convert a llama-3 tiktoken vocab to .t")
+    ap.add_argument("model", help="tiktoken file (tokenizer.model)")
+    ap.add_argument("output")
+    args = ap.parse_args(argv)
+    data = llama3_to_tokenizer_data(args.model)
+    write_tokenizer_file(args.output, data)
+    print(f"✅ wrote {args.output}: vocab={data.vocab_size} "
+          f"bos={data.bos_id} eos={data.eos_id}")
+
+
+if __name__ == "__main__":
+    main()
