@@ -59,6 +59,8 @@ def build_argparser() -> argparse.ArgumentParser:
     # TPU-native flags
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh size")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh size")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel mesh size (ring-attention prefill)")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--cache-dtype", default="bf16", choices=["bf16", "f32"])
@@ -99,9 +101,9 @@ def build_engine(args):
     kdt = jnp.bfloat16 if args.cache_dtype == "bf16" else jnp.float32
 
     mesh = None
-    if args.tp > 1 or args.dp > 1:
+    if args.tp > 1 or args.dp > 1 or args.sp > 1:
         from ..parallel.mesh import make_mesh
-        mesh = make_mesh(tp=args.tp, dp=args.dp)
+        mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp)
 
     params = load_params(spec, tensors, mode=mode, dtype=cdt)
     engine = Engine(

@@ -64,7 +64,8 @@ def _layer_weights(params: dict, spec: ModelSpec) -> dict:
     return {k: params[k] for k in keys}
 
 
-def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg):
+def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
+                     sp_mesh=None):
     """Norm -> QKV -> RoPE -> cache update -> attention -> output proj.
 
     Returns (attn_out, new_k_cache, new_v_cache). attn_out is the wo
@@ -88,7 +89,16 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg):
     v_cache = lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, pos0[0], 0, 0))
 
-    att = decode_attention(q, k_cache, v_cache, q_pos)  # (B, T, H, hs)
+    if sp_mesh is not None:
+        # sequence-parallel prefill: the segment starts at pos 0 and IS the
+        # whole context so far, so attention runs q-chunk vs ring-rotating
+        # k/v chunks instead of against the cache (net-new vs the reference —
+        # SURVEY.md §5.7)
+        from ..parallel.ring_attention import ring_attention
+
+        att = ring_attention(q, k, v, sp_mesh, pos0=0)
+    else:
+        att = decode_attention(q, k_cache, v_cache, q_pos)  # (B, T, H, hs)
     out = matmul(att.reshape(b, t, h * hs), lw["wo"], **cfg)
     return out, k_cache, v_cache
 
@@ -169,8 +179,9 @@ def _take_expert(w, e):
     return lax.dynamic_index_in_dim(w, e, axis=0, keepdims=False)
 
 
-def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg):
-    attn_out, k_cache, v_cache = _attention_block(x, lw, spec, k_cache, v_cache, q_pos, cfg)
+def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg, sp_mesh=None):
+    attn_out, k_cache, v_cache = _attention_block(
+        x, lw, spec, k_cache, v_cache, q_pos, cfg, sp_mesh=sp_mesh)
 
     if spec.arch == ArchType.GROK1:
         # post-attention norm BEFORE residual add (ref: grok1-tasks.cpp:16-41)
@@ -201,10 +212,16 @@ def forward(
     compute_dtype=jnp.float32,
     logits_for_all: bool = False,
     use_pallas: bool = False,
+    sp_mesh=None,
+    logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
 
-    logits: (B, vocab) for the last token, or (B, T, vocab) if logits_for_all.
+    logits: (B, vocab) for the last token (or position `logit_index` if
+    given — used when the segment is right-padded), or (B, T, vocab) if
+    logits_for_all.
+    sp_mesh: a Mesh whose sp axis shards this segment's sequence — enables the
+    ring-attention prefill path (segment must start at pos 0).
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
                use_pallas=use_pallas)
@@ -221,14 +238,20 @@ def forward(
 
     def scan_body(x, layer_in):
         lw, k_cache, v_cache = layer_in
-        x_new, k_new, v_new = _layer(x, lw, spec, k_cache, v_cache, q_pos, cfg)
+        x_new, k_new, v_new = _layer(x, lw, spec, k_cache, v_cache, q_pos, cfg,
+                                     sp_mesh=sp_mesh)
         return x_new, (k_new, v_new)
 
     x, (k_all, v_all) = lax.scan(scan_body, x, (lws, cache.k, cache.v))
 
     x = rmsnorm(x, params["rms_final"])  # ref: llama2-tasks.cpp:222-234
     if not logits_for_all:
-        x = x[:, -1, :]
+        if logit_index is None:
+            x = x[:, -1, :]
+        else:
+            x = jnp.take_along_axis(
+                x, jnp.broadcast_to(logit_index.reshape(1, 1, 1),
+                                    (x.shape[0], 1, x.shape[-1])), axis=1)[:, 0]
     wcls = params["wcls"][0]
     logits = matmul(x, wcls, **cfg).astype(jnp.float32)
     if spec.arch == ArchType.GROK1:

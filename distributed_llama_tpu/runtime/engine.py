@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.spec import ModelSpec
 from ..models.transformer import KVCache, forward
-from ..parallel.mesh import DP_AXIS
+from ..parallel.mesh import DP_AXIS, SP_AXIS
 from ..parallel.sharding import cache_pspec, check_tp_constraints, shard_params
 from ..sampler import Sampler
 from .stats import RunStats, StepStats
@@ -83,7 +83,7 @@ class Engine:
 
         self.cache = self._new_cache()
         self.pos = 0
-        self._steps: dict[int, Callable] = {}
+        self._steps: dict[int | tuple[str, int], Callable] = {}
 
     # -- cache ------------------------------------------------------------
 
@@ -138,8 +138,16 @@ class Engine:
     # -- generation -------------------------------------------------------
 
     def prefill(self, prompt: list[int]) -> jax.Array:
-        """Feed the prompt in fixed-size chunks; returns last logits."""
+        """Feed the prompt in fixed-size chunks; returns last logits.
+
+        When the mesh has an sp axis > 1 and this is the start of a session,
+        the whole prompt runs as ONE ring-attention segment with the sequence
+        sharded over sp (long-context path, net-new vs the reference)."""
         assert self.batch == 1, "prefill() is single-sequence; use step() for batches"
+        sp = self.mesh.shape.get(SP_AXIS, 1) if self.mesh is not None else 1
+        if (sp > 1 and self.pos == 0 and len(prompt) > 1
+                and len(prompt) + (-len(prompt)) % sp <= self.seq_len):
+            return self._prefill_ring(prompt, sp)
         logits = None
         i = 0
         n = len(prompt)
@@ -148,6 +156,38 @@ class Engine:
             seg = np.asarray(prompt[i:i + chunk], np.int32)[None, :]
             logits = self.step(seg, self.pos)
             i += chunk
+        return logits
+
+    def _prefill_ring(self, prompt: list[int], sp: int) -> jax.Array:
+        """Whole-prompt sequence-parallel prefill: pad to a multiple of sp,
+        shard tokens over the sp axis, attend via ring attention, sample at
+        the true last prompt position. Padded positions land in the cache at
+        indices >= pos and are therefore never attended by later decode."""
+        n = len(prompt)
+        pad = (-n) % sp
+        t = n + pad
+        assert t <= self.seq_len, "context overflow"  # caller checked padding fits
+
+        key = ("ring", t)
+        if key not in self._steps:
+            def run(params, tokens, logit_index, cache):
+                return forward(
+                    params, self.spec, tokens, jnp.int32(0), cache,
+                    activation_q80=self.activation_q80,
+                    compute_dtype=self.compute_dtype,
+                    use_pallas=self.use_pallas,
+                    sp_mesh=self.mesh,
+                    logit_index=logit_index,
+                )
+            self._steps[key] = jax.jit(run, donate_argnums=(3,))
+
+        seg = np.zeros((1, t), np.int32)
+        seg[0, :n] = prompt
+        tok = jax.device_put(jnp.asarray(seg),
+                             NamedSharding(self.mesh, P(DP_AXIS, SP_AXIS)))
+        logits, self.cache = self._steps[key](
+            self.params, tok, jnp.int32(n - 1), self.cache)
+        self.pos = n
         return logits
 
     def generate(

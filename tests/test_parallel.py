@@ -106,7 +106,7 @@ def test_param_pspecs_cover_all_leaves():
 def test_q80_psum_matches_psum():
     """Quantized all-reduce ~ exact all-reduce (the reference's Q80 wire,
     ref: src/tasks.cpp:124-163)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = make_mesh(tp=8)
     x = np.random.default_rng(0).standard_normal((8, 4, 64)).astype(np.float32)
@@ -114,13 +114,13 @@ def test_q80_psum_matches_psum():
     @jax.jit
     def exact(x):
         f = shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
-                      in_specs=P("tp"), out_specs=P(), check_rep=False)
+                      in_specs=P("tp"), out_specs=P(), check_vma=False)
         return f(x)
 
     @jax.jit
     def quantized(x):
         f = shard_map(lambda v: q80_psum(v[0], "tp")[None], mesh=mesh,
-                      in_specs=P("tp"), out_specs=P(), check_rep=False)
+                      in_specs=P("tp"), out_specs=P(), check_vma=False)
         return f(x)
 
     a = np.asarray(exact(x))
