@@ -43,33 +43,38 @@ TINY = ModelSpec(
 def _rand_q40(rng: np.random.Generator, *shape: int) -> QuantizedTensor:
     """Random Q40 weight of logical shape (..., n): packed nibbles + scales
     sized so dequantized values land in a healthy ~N(0, 0.02) range.
-    Generated directly in the device layout (..., 16, nb)."""
+    Generated directly in the device layout (..., 16*nb) flattened; scales
+    f32 as on device (quants/jax_codec.py)."""
     nb = shape[-1] // 32
-    packed = rng.integers(0, 256, (*shape[:-1], 16, nb), dtype=np.uint8)
+    packed = rng.integers(0, 256, (*shape[:-1], 16 * nb), dtype=np.uint8)
     scales = (rng.random((*shape[:-1], nb), dtype=np.float32) * 0.004 + 0.001)
-    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales.astype(np.float16)))
+    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
 
 
 def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict:
     rng = np.random.default_rng(seed)
-    L, d, h = spec.n_layers, spec.dim, spec.hidden_dim
+    d, h = spec.dim, spec.hidden_dim
     kv = spec.kv_dim
-    p = {
+    layers = []
+    for _ in range(spec.n_layers):
+        layers.append({
+            "rms_att": jnp.ones((d,), jnp.float32),
+            "rms_ffn": jnp.ones((d,), jnp.float32),
+            "wq": _rand_q40(rng, d, d),
+            "wk": _rand_q40(rng, kv, d),
+            "wv": _rand_q40(rng, kv, d),
+            "wo": _rand_q40(rng, d, d),
+            "w1": _rand_q40(rng, h, d),
+            "w2": _rand_q40(rng, d, h),
+            "w3": _rand_q40(rng, h, d),
+        })
+    return {
         "tok_emb": jnp.asarray(
             rng.standard_normal((spec.vocab_size, d), dtype=np.float32) * 0.02, dtype),
-        "rms_att": jnp.ones((L, d), jnp.float32),
-        "rms_ffn": jnp.ones((L, d), jnp.float32),
+        "layers": layers,
         "rms_final": jnp.ones((d,), jnp.float32),
-        "wq": _rand_q40(rng, L, d, d),
-        "wk": _rand_q40(rng, L, kv, d),
-        "wv": _rand_q40(rng, L, kv, d),
-        "wo": _rand_q40(rng, L, d, d),
-        "w1": _rand_q40(rng, L, h, d),
-        "w2": _rand_q40(rng, L, d, h),
-        "w3": _rand_q40(rng, L, h, d),
-        "wcls": _rand_q40(rng, 1, spec.vocab_size, d),
+        "wcls": _rand_q40(rng, spec.vocab_size, d),
     }
-    return p
 
 
 def main() -> None:

@@ -50,7 +50,10 @@ def matmul(
         if use_pallas:
             from .pallas_q40 import q40_matmul, supports_pallas
 
-            if supports_pallas(w):
+            t = 1
+            for s in x.shape[:-1]:
+                t *= s
+            if supports_pallas(w, t):
                 return q40_matmul(x, w, out_dtype=compute_dtype)
         wd = dequantize_q40_jax(w, dtype=compute_dtype)
     else:

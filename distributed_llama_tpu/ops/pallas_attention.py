@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: flash-decode attention over the KV cache.
+
+TPU-native replacement for the reference's serial per-head attention loop
+(ref: src/llama2-tasks.cpp:54-94). XLA's fused decode attention kept
+assigning the KV cache a head-minor layout (32 kv heads in the 128-lane
+dim -> 4x lane waste, ~75 GB/s effective on v5e); this kernel fixes the
+read pattern by construction: each grid step streams one head's (SB, hs)
+key/value panel — hs=128 exactly fills the lanes — and keeps the running
+softmax state in VMEM scratch, so scores never touch HBM.
+
+Shapes: q (B, KVH, G, hs) where G = n_heads/n_kv_heads (GQA group,
+ref kvMul: src/llama2-tasks.cpp:60); k/v cache (B, KVH, S, hs). Grid is
+(B*KVH, S/SB) with the sequence dimension innermost: scratch acc/m/l carry
+the online-softmax state across S blocks of the same head (flash
+decomposition), reset at block 0 and finalized at the last block.
+
+Causality: decode attends to all cache positions s <= pos (the cache is
+already updated at the query's position); positions beyond pos — including
+cache slots not yet written — are masked with -inf before the softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEF_BLOCK_S = 512
+NEG_INF = -1e30
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
+            *, sb, n_sb, kvh, scale, out_dtype):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                   # (G, hs)
+    k = k_ref[0]                                   # (SB, hs)
+    v = v_ref[0]
+
+    dot = functools.partial(
+        jax.lax.dot_general,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
+    )
+    scores = dot(q, k, dimension_numbers=(((1,), (1,)), ((), ()))) * scale  # (G, SB)
+
+    b = pl.program_id(0) // kvh
+    pos = pos_ref[b]
+    s_pos = j * sb + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(s_pos <= pos, scores, NEG_INF)
+
+    m_prev = m_ref[:]                              # (G, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)                    # (G, SB); masked cols underflow to 0
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = dot(p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())))
+    acc_ref[:] = acc_ref[:] * alpha + pv
+    m_ref[:] = m_new
+
+    @pl.when(j == n_sb - 1)
+    def _done():
+        out_ref[0] = (acc_ref[:] / l_ref[:]).astype(out_dtype)
+
+
+def _block_s(s: int) -> int:
+    for sb in (DEF_BLOCK_S, 256, 128):
+        if s % sb == 0:
+            return sb
+    return s
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_decode_attention(
+    q: jnp.ndarray,        # (B, T=1, H, hs)
+    k_cache: jnp.ndarray,  # (B, KVH, S, hs)
+    v_cache: jnp.ndarray,  # (B, KVH, S, hs)
+    q_pos: jnp.ndarray,    # (B, T=1) absolute position of the query token
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-position decode attention; returns (B, 1, H, hs).
+
+    Matches ops/attention.decode_attention semantics for T == 1.
+    """
+    b, t, h, hs = q.shape
+    assert t == 1, "flash decode is T=1; prefill uses decode_attention/ring"
+    kvh, s = k_cache.shape[1], k_cache.shape[2]
+    g = h // kvh
+    sb = _block_s(s)
+    n_sb = s // sb
+
+    # kernel dots need matching operand dtypes (lax.dot_general does not
+    # promote); compute dtype and cache dtype may differ
+    q = q.astype(k_cache.dtype)
+    qh = q.reshape(b, kvh, g, hs).reshape(b * kvh, g, hs)
+    kh = k_cache.reshape(b * kvh, s, hs)
+    vh = v_cache.reshape(b * kvh, s, hs)
+    pos = q_pos[:, 0].astype(jnp.int32)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, sb=sb, n_sb=n_sb, kvh=kvh,
+            scale=1.0 / (hs ** 0.5), out_dtype=q.dtype),
+        grid=(b * kvh, n_sb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, g, hs), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sb, hs), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sb, hs), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, g, hs), lambda i, j: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * kvh, g, hs), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((g, hs), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(pos, qh, kh, vh)
+
+    return out.reshape(b, h, hs)[:, None]

@@ -5,11 +5,27 @@ TPU-native replacement for the reference's hot Q40xQ80 NEON/AVX2 kernel
 SIMD integer dot products; here the same HBM-traffic win comes from reading
 the packed nibbles (0.5625 B/weight + 1/16 scale byte) and dequantizing in
 VMEM right before the MXU contraction — the dense weight matrix never
-touches HBM. At decode batch=1 the op is bandwidth-bound, so this beats
-dequantize-to-dense + dot (which moves ~4.5 B/weight through HBM).
+touches HBM.
 
-Layout: QuantizedTensor packed is nibble-position-major (d, 16, nb) uint8
-(see quants/jax_codec.py) so the flattened lane order is m = j*nb + b.
+Decode at batch=1 makes this op VPU-bound on the unpack arithmetic (the
+packed read itself is far under the HBM roofline), so the kernel minimizes
+per-byte VPU work with an algebraic restructure. With the reference decoder
+value = (nibble - 8) * scale (ref: src/quants.cpp:166-179):
+
+    y = x_lo·(lo-8)s + x_hi·(hi-8)s
+      = x_lo·(lo s) + x_hi·(hi s) - 8 Σ_b s[d,b]·xsum[b]
+
+so the per-element subtractions vanish: the hot loop touches each packed
+byte with only widen, and, shift, two converts, and two scale-muls. The
+correction term is a tiny (t, nb)x(td, nb) dot of per-block activation sums
+against the scales already resident in VMEM. (A further restructure that
+feeds the raw byte pk = lo + 16*hi to the MXU saves the `and` but amplifies
+f32 rounding ~36x through cancellation — rejected. bf16 VPU arithmetic
+measures *slower* than f32 — the VPU is f32-native.)
+
+Layout: QuantizedTensor packed is nibble-position-major, stored flattened
+(d, m) uint8 with lane order m = j*nb + b (see quants/jax_codec.py) — the
+kernel consumes the HBM buffer in place, no reshape/re-tile.
 Consequences inside the kernel:
   * the per-block scale expansion s16[d, m] = s[d, m % nb] is a lane tile —
     exactly `pltpu.repeat(s, 16)` (an element-wise repeat of the block-major
@@ -18,8 +34,6 @@ Consequences inside the kernel:
     outside the kernel into matching lo/hi orders:
       x_lo[t, j*nb + b] = x[t, b*32 + j]       (low-nibble elements)
       x_hi[t, j*nb + b] = x[t, b*32 + 16 + j]  (high-nibble elements)
-Then  y = x_lo @ dequant(lo).T + x_hi @ dequant(hi).T  with the reference's
-decoder semantics value = (nibble - 8) * scale (ref: src/quants.cpp:166-179).
 """
 
 from __future__ import annotations
@@ -34,44 +48,55 @@ from jax.experimental.pallas import tpu as pltpu
 from ..quants.jax_codec import QuantizedTensor
 
 LANES = 128
-DEF_TILE_D = 256
+# output-dim tile candidates, largest first (larger tiles amortize grid
+# overhead; measured td=1024 ~7% faster than td=256 on v5e)
+TILE_D_CANDIDATES = (1024, 512, 256, LANES)
+# above this token count the op is FLOPs-amortized and the XLA dequant path
+# is used instead; also bounds the kernel's (t, m) VMEM blocks (ADVICE r1)
+MAX_T = 256
 
 
-def _kernel(x_lo_ref, x_hi_ref, packed_ref, scales_ref, out_ref, *, nb, out_dtype):
-    # ref decoder: (q & 0xF) - 8. Mosaic legalizes neither i8 arithmetic nor
-    # u8 shifts, so widen to i32 first and keep the -8 and scale on the f32 VPU
+def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
+            *, nb, out_dtype):
     pk = packed_ref[:].astype(jnp.int32)                 # (TD, M=16*nb)
-    lo = (pk & 0xF).astype(jnp.float32) - 8.0
-    hi = (pk >> 4).astype(jnp.float32) - 8.0
+    lo = (pk & 0xF).astype(jnp.float32)
+    hi = (pk >> 4).astype(jnp.float32)
     s = scales_ref[:]                                    # (TD, NB) f32 — Mosaic has no f16
     s16 = pltpu.repeat(s, 16, axis=1)                    # lane-tile -> (TD, M)
-    wlo = lo * s16
-    whi = hi * s16
 
+    # DEFAULT precision: single-pass MXU feed (HIGHEST = multi-pass f32
+    # decomposition, measured ~5x slower for the whole kernel); operands are
+    # engine-bf16 activations and 4-bit weights, so nothing real is lost
     dot = functools.partial(
         jax.lax.dot_general,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
     )
-    acc = dot(x_lo_ref[:], wlo) + dot(x_hi_ref[:], whi)  # (T, TD)
+    acc = dot(x_lo_ref[:], lo * s16)                     # (T, TD)
+    acc += dot(x_hi_ref[:], hi * s16)
+    acc += dot(xsum_ref[:], s) * -8.0                    # fold every (nib-8) offset
     out_ref[:] = acc.astype(out_dtype)
 
 
-def _tile_d(d: int, tile_d: int = DEF_TILE_D) -> int:
+def _tile_d(d: int, m: int) -> int:
     """Output-dim tile: Mosaic wants the last block dim to be a multiple of
-    128 lanes OR the whole array dim — so tile by 256/128 when divisible,
-    else take d whole (grid of 1)."""
-    for t in (tile_d, LANES):
-        if d % t == 0:
+    128 lanes OR the whole array dim — so tile by the largest divisor from
+    the candidate list whose f32 unpack intermediates (the dominant VMEM
+    consumers, ~4 bytes per packed byte each) stay within the ~16 MB
+    scoped-VMEM budget, else take d whole (grid of 1)."""
+    for t in TILE_D_CANDIDATES:
+        if d % t == 0 and t * m <= 2_300_000:
             return t
     return d
 
 
-def supports_pallas(w: QuantizedTensor) -> bool:
-    """Kernel precondition: 2D weight (d, 16, nb) — callers slice leading
-    (layer/expert) dims first. m/nb ride as full-size blocks, so no lane
-    alignment is required of them."""
-    return w.packed.ndim == 3
+def supports_pallas(w: QuantizedTensor, t: int = 1) -> bool:
+    """Kernel preconditions: 2D weight (d, m) — callers slice leading
+    (layer/expert) dims first — and a token count small enough that decode/
+    short-prefill VMEM blocks fit (longer segments are FLOPs-amortized and
+    take the XLA dequant path)."""
+    return w.packed.ndim == 2 and t <= MAX_T
 
 
 def _split_activation(x: jnp.ndarray, nb: int) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -96,18 +121,19 @@ def q40_matmul(
     leading dims. Weight stays packed through HBM; dequant happens per-tile in
     VMEM fused into the MXU contraction.
     """
-    d, _, nb = w.packed.shape
+    d, m = w.packed.shape
+    nb = m // 16
     n = nb * 32
-    m = nb * 16
 
     lead = x.shape[:-1]
     t = 1
     for s in lead:
         t *= s
     x_lo, x_hi = _split_activation(x.reshape(t, n).astype(jnp.float32), nb)
+    xsum = (x_lo + x_hi).reshape(t, 16, nb).sum(axis=1)  # (t, nb) per-block sums
 
-    packed2d = w.packed.reshape(d, m)
-    td = _tile_d(d)
+    packed2d = w.packed  # already stored flattened (d, m) — consumed in place
+    td = _tile_d(d, m)
     grid = (d // td,)
 
     out = pl.pallas_call(
@@ -116,6 +142,7 @@ def q40_matmul(
         in_specs=[
             pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((td, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((td, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
@@ -127,6 +154,6 @@ def q40_matmul(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(x_lo, x_hi, packed2d, w.scales.astype(jnp.float32))
+    )(x_lo, x_hi, xsum, packed2d, w.scales.astype(jnp.float32))
 
     return out.reshape(*lead, d)

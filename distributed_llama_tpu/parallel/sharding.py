@@ -29,7 +29,8 @@ from ..quants.jax_codec import QuantizedTensor
 from .mesh import DP_AXIS, TP_AXIS
 
 # per-param logical split: 'row' = shard output dim, 'col' = shard input dim,
-# None = replicate. Axis positions account for the leading stacking dims.
+# None = replicate. Axis positions account for leading stacking dims (the
+# per-expert E axis on MoE weights; layers are a pytree list, not an axis).
 _SPLIT = {
     "tok_emb": None,
     "rms_att": None,
@@ -41,8 +42,10 @@ _SPLIT = {
     "wq": "row",
     "wk": "row",
     "wv": "row",
+    "wqkv": "row",  # fused single-shard variants (models/params.py)
     "w1": "row",
     "w3": "row",
+    "w13": "row",
     "moe_up": "row",
     "moe_gate": "row",
     "moe_down": "col",
@@ -55,43 +58,47 @@ _SPLIT = {
 def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
     """PartitionSpec for one array leaf.
 
-    Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, 16, nb)
-    and scales (lead..., d, nb): the n/col split maps onto the block axis nb
-    (blocks are 32 wide; any tp shard of nb keeps whole blocks).
+    Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, m)
+    — flattened nibble-position-major, m = 16*nb — and scales (lead..., d, nb).
+    Row split shards the d axis for all three forms. Col split shards the
+    last axis; for the packed form a contiguous m shard is a nibble-position
+    stripe rather than a block stripe, which GSPMD handles transparently
+    (the dequant reshape introduces a resharding); the shard_map TP path
+    slices at the logical-tensor level instead and stays block-aligned.
     """
     split = _SPLIT[name]
     axes: list = [None] * ndim
     if split is None:
         return P(*axes)
-    if quantized:
-        # packed: (..., d, 16, nb) ; scales: (..., d, nb)
-        d_axis = ndim - 3 if which == "packed" else ndim - 2
-        nb_axis = ndim - 1
-    else:
-        d_axis = ndim - 2
-        nb_axis = ndim - 1
-    axes[d_axis if split == "row" else nb_axis] = TP_AXIS
+    axes[ndim - 2 if split == "row" else ndim - 1] = TP_AXIS
     return P(*axes)
 
 
+def _leaf_spec(name: str, w):
+    if isinstance(w, QuantizedTensor):
+        return QuantizedTensor(  # pytree-shaped specs
+            _pspec_for(name, w.packed.ndim, True, "packed"),
+            _pspec_for(name, w.scales.ndim, True, "scales"),
+        )
+    return _pspec_for(name, w.ndim, False, "dense")
+
+
 def param_pspecs(params: dict) -> dict:
-    """Pytree of PartitionSpecs matching the params pytree."""
+    """Pytree of PartitionSpecs matching the params pytree
+    ({"tok_emb", "rms_final", "wcls", "layers": [{...}, ...]})."""
     out = {}
     for name, w in params.items():
-        if isinstance(w, QuantizedTensor):
-            out[name] = QuantizedTensor(  # pytree-shaped specs
-                _pspec_for(name, w.packed.ndim, True, "packed"),
-                _pspec_for(name, w.scales.ndim, True, "scales"),
-            )
+        if name == "layers":
+            out[name] = [{k: _leaf_spec(k, v) for k, v in lw.items()} for lw in w]
         else:
-            out[name] = _pspec_for(name, w.ndim, False, "dense")
+            out[name] = _leaf_spec(name, w)
     return out
 
 
 def cache_pspec() -> P:
-    """KV cache (L, B, S, KVH, hs): batch on dp, kv-heads on tp
+    """Per-layer KV cache leaf (B, KVH, S, hs): batch on dp, kv-heads on tp
     (ref: KvCacheSlice, src/transformer.cpp:161-171)."""
-    return P(None, DP_AXIS, None, TP_AXIS, None)
+    return P(DP_AXIS, TP_AXIS, None, None)
 
 
 def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:
@@ -119,11 +126,18 @@ def shard_params(params: dict, mesh) -> dict:
     def put(w, s):
         return jax.device_put(w, NamedSharding(mesh, s))
 
+    def put_entry(w, sp):
+        if isinstance(w, QuantizedTensor):
+            return QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))
+        return put(w, sp)
+
     out = {}
     for name, w in params.items():
-        sp = specs[name]
-        if isinstance(w, QuantizedTensor):
-            out[name] = QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))
+        if name == "layers":
+            out[name] = [
+                {k: put_entry(v, specs[name][i][k]) for k, v in lw.items()}
+                for i, lw in enumerate(w)
+            ]
         else:
-            out[name] = put(w, sp)
+            out[name] = put_entry(w, specs[name])
     return out

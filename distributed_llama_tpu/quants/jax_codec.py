@@ -6,12 +6,15 @@ reference's interleaved 18-byte blocks (ref: src/quants.hpp:16-19) — the
 layout XLA/Pallas can tile: nibble-unpack and scale-multiply fuse into the
 consuming matmul, and both arrays shard cleanly over a mesh axis.
 
-Device layout is nibble-position-major: packed (..., 16, nb) where
-packed[..., j, b] holds byte j of block b — the transpose of the host/file
-block-major order (..., nb, 16). This is chosen for the Pallas kernel
-(ops/pallas_q40.py): flattening gives lane order m = j*nb + b, so the
-per-block scale expansion becomes a lane-tile (pltpu.repeat) instead of an
-element-wise repeat Mosaic cannot lower. `from_numpy` performs the swap.
+Device layout is nibble-position-major and pre-flattened 2D: packed
+(..., m) with m = 16*nb and lane order m = j*nb + b (packed[..., j*nb + b]
+holds byte j of block b) — the transpose of the host/file block-major order
+(..., nb, 16). This is chosen for the Pallas kernel (ops/pallas_q40.py):
+the per-block scale expansion becomes a lane-tile (pltpu.repeat) instead of
+an element-wise repeat Mosaic cannot lower, and storing the flattened form
+directly means the kernel consumes the HBM buffer in place — a (..., 16, nb)
+3D form would re-tile (copy) on every reshape because TPU tiling of the
+last two dims differs. `from_numpy` performs the swap + flatten.
 
 Numerics match the reference decoder (ref: src/quants.cpp:166-179): value =
 (nibble - 8) * f16_scale, lower nibbles are elements [0,16) of the block and
@@ -33,10 +36,15 @@ from .types import BLOCK_SIZE
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedTensor:
-    """Q40 tensor of logical shape (..., n): packed (..., 16, n//32) u8 + scales (..., n//32) f16."""
+    """Q40 tensor of logical shape (..., n): packed (..., n//2) u8 + scales (..., n//32) f32.
+
+    Scales are f16 in the file format but widened to f32 on device: Mosaic
+    has no f16, so f16 scales would force a convert+materialize per matmul
+    call — paying the widened read (+25% of packed bytes) once per token is
+    cheaper than converting per call."""
 
     packed: jax.Array  # uint8
-    scales: jax.Array  # float16
+    scales: jax.Array  # float32 on device (f16 in the .m file)
 
     def tree_flatten(self):
         return (self.packed, self.scales), None
@@ -65,14 +73,20 @@ class QuantizedTensor:
 
     @classmethod
     def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
-        """Host block-major packed (..., nb, 16) -> device (..., 16, nb)."""
-        return cls(jnp.asarray(packed.swapaxes(-1, -2)), jnp.asarray(scales))
+        """Host block-major packed (..., nb, 16) -> device flattened (..., 16*nb);
+        f16 file scales widen to f32 (see class docstring)."""
+        nb = packed.shape[-2]
+        swapped = np.ascontiguousarray(packed.swapaxes(-1, -2))
+        return cls(jnp.asarray(swapped.reshape(*swapped.shape[:-2], 16 * nb)),
+                   jnp.asarray(scales.astype(np.float32)))
 
 
 def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
     """Unpack Q40 to a dense array of `dtype` with logical shape t.shape."""
-    lo = (t.packed & 0xF).astype(jnp.int8) - 8   # (..., 16, nb): [j, b]
-    hi = (t.packed >> 4).astype(jnp.int8) - 8
+    nb = t.scales.shape[-1]
+    pk = t.packed.reshape(*t.packed.shape[:-1], 16, nb)  # [j, b]
+    lo = (pk & 0xF).astype(jnp.int8) - 8
+    hi = (pk >> 4).astype(jnp.int8) - 8
     vals = jnp.concatenate([lo, hi], axis=-2)    # (..., 32, nb): k = h*16 + j
     out = vals.astype(dtype) * t.scales[..., None, :].astype(dtype)
     # dense[..., b*32 + k] = vals[..., k, b]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..models.params import fuse_layer_weights
 from ..models.spec import ModelSpec
 from ..models.transformer import KVCache, forward
 from ..parallel.mesh import DP_AXIS, SP_AXIS
@@ -60,18 +61,30 @@ class Engine:
         self.cache_dtype = cache_dtype
         self.activation_q80 = activation_q80
         self.prefill_chunk = prefill_chunk
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         if use_pallas is None:
-            # default off: measured at parity with the XLA dequant path on the
-            # current chip (decode is MXU-latency-bound at batch=1, so the
-            # packed-HBM-read saving doesn't pay yet) — opt in explicitly
+            # default ON for TPU: the fused kernel reads only packed bytes and
+            # keeps the unpack at ~6 VPU ops/byte (measured v5e: 2.4 ms vs
+            # 5.0 ms XLA-dequant for the same 0.81 GB packed weight set);
+            # prefill segments longer than pallas_q40.MAX_T fall back to the
+            # FLOPs-amortized XLA dequant path automatically. On CPU (tests,
+            # virtual meshes) Mosaic can't compile — use the XLA path.
+            use_pallas = jax.default_backend() != "cpu"
+        if mesh is not None and mesh.size > 1:
+            # GSPMD cannot auto-partition Pallas custom calls over sharded
+            # operands (tp-sharded weights, dp-sharded cache/activations) —
+            # multi-device meshes use the XLA dequant + fused-attention path
             use_pallas = False
         self.use_pallas = use_pallas
 
+        if tp == 1:
+            # single-shard fast path: fused QKV / w1|w3 kernel calls
+            params = fuse_layer_weights(params)
         if mesh is not None:
             from ..quants.jax_codec import QuantizedTensor
 
-            tp = mesh.shape.get("tp", 1)
-            q40 = any(isinstance(v, QuantizedTensor) for v in params.values())
+            q40 = any(isinstance(v, QuantizedTensor)
+                      for lw in params["layers"] for v in lw.values())
             check_tp_constraints(spec, tp, q40=q40)
             self.params = shard_params(params, mesh)
             self._cache_sharding = NamedSharding(mesh, cache_pspec())

@@ -45,10 +45,11 @@ def test_leading_dims_flattened(rng):
 
 
 def test_supports_and_tiles():
-    assert _tile_d(4096) == 256
-    assert _tile_d(11008) == 256
-    assert _tile_d(704) == 704     # whole-dim fallback
-    assert _tile_d(32000) == 256
+    assert _tile_d(4096, 2048) == 1024
+    assert _tile_d(4096, 5504) == 256     # w2: bigger m, smaller tile
+    assert _tile_d(11008, 2048) == 256    # 11008 has no 512/1024 divisor
+    assert _tile_d(704, 2048) == 704      # whole-dim fallback
+    assert _tile_d(32000, 2048) == 256
     rng = np.random.default_rng(0)
     qt = _qt(rng, 128, 256)
     assert supports_pallas(qt)

@@ -95,12 +95,22 @@ def test_param_pspecs_cover_all_leaves():
         params = load_params(spec, host, mode=mode)
         specs = param_pspecs(params)
         assert set(specs) == set(params)
-        for name, w in params.items():
+
+        def check(w, sp):
             if isinstance(w, QuantizedTensor):
-                assert len(specs[name].packed) == w.packed.ndim
-                assert len(specs[name].scales) == w.scales.ndim
+                assert len(sp.packed) == w.packed.ndim
+                assert len(sp.scales) == w.scales.ndim
             else:
-                assert len(specs[name]) == w.ndim
+                assert len(sp) == w.ndim
+
+        for name, w in params.items():
+            if name == "layers":
+                for lw, lsp in zip(w, specs[name]):
+                    assert set(lsp) == set(lw)
+                    for k in lw:
+                        check(lw[k], lsp[k])
+            else:
+                check(w, specs[name])
 
 
 def test_q80_psum_matches_psum():

@@ -1,0 +1,186 @@
+"""Pallas Q40 kernel variants — measured head-to-head on the real chip.
+
+Each variant computes y = x @ dequant(W).T for W (d, n) in packed Q40.
+Correctness is checked against the XLA dequant path before timing.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor, dequantize_q40_jax
+from distributed_llama_tpu.ops.pallas_q40 import q40_matmul, _split_activation
+
+L, D, H = 32, 4096, 11008
+R1, R2 = 2, 10
+
+
+def slope(make_run, *args):
+    ts = {}
+    for reps in (R1, R2):
+        fn = make_run(reps)
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            np.asarray(jax.tree.leaves(out)[0])
+            best = min(best, time.perf_counter() - t0)
+        ts[reps] = best
+    return (ts[R2] - ts[R1]) / (R2 - R1)
+
+
+def _q40(shape_d, shape_n, layers=L, seed=0):
+    rng = np.random.default_rng(seed)
+    nb = shape_n // 32
+    packed = rng.integers(0, 256, (layers, shape_d, 16, nb), dtype=np.uint8)
+    scales = (rng.random((layers, shape_d, nb), dtype=np.float32) * 0.004).astype(np.float16)
+    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
+
+
+# ---- variant A: bf16 muls + bf16 MXU dots, keep -8 on VPU -----------------
+
+def _kernel_a(x_lo_ref, x_hi_ref, packed_ref, scales_ref, out_ref, *, nb):
+    pk = packed_ref[:].astype(jnp.int32)
+    lo = (pk & 0xF).astype(jnp.bfloat16) - jnp.bfloat16(8)
+    hi = (pk >> 4).astype(jnp.bfloat16) - jnp.bfloat16(8)
+    s = scales_ref[:]
+    s16 = pltpu.repeat(s, 16, axis=1).astype(jnp.bfloat16)
+    wlo = lo * s16
+    whi = hi * s16
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc = dot(x_lo_ref[:], wlo) + dot(x_hi_ref[:], whi)
+    out_ref[:] = acc
+
+
+def q40_matmul_a(x, w, td=256):
+    d, _, nb = w.packed.shape
+    n, m = nb * 32, nb * 16
+    t = x.shape[0]
+    x_lo, x_hi = _split_activation(x.astype(jnp.float32), nb)
+    x_lo = x_lo.astype(jnp.bfloat16)
+    x_hi = x_hi.astype(jnp.bfloat16)
+    packed2d = w.packed.reshape(d, m)
+    grid = (d // td,)
+    out = pl.pallas_call(
+        functools.partial(_kernel_a, nb=nb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t, td), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+    )(x_lo, x_hi, packed2d, w.scales.astype(jnp.float32))
+    return out
+
+
+# ---- variant B: bf16 + correction trick (no -8 in the hot loop) -----------
+
+def _kernel_b(x_lo_ref, x_hi_ref, packed_ref, scales_ref, corr_ref, out_ref, *, nb):
+    pk = packed_ref[:].astype(jnp.int32)
+    lo = (pk & 0xF).astype(jnp.bfloat16)
+    hi = (pk >> 4).astype(jnp.bfloat16)
+    s16 = pltpu.repeat(scales_ref[:], 16, axis=1).astype(jnp.bfloat16)
+    wlo = lo * s16
+    whi = hi * s16
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc = dot(x_lo_ref[:], wlo) + dot(x_hi_ref[:], whi)
+    out_ref[:] = acc + corr_ref[:]
+
+
+def q40_matmul_b(x, w, td=256):
+    d, _, nb = w.packed.shape
+    n, m = nb * 32, nb * 16
+    t = x.shape[0]
+    xf = x.astype(jnp.float32)
+    x_lo, x_hi = _split_activation(xf, nb)
+    # correction: -8 * sum_b s[d,b] * (sum_j (x_lo+x_hi)[t, j*nb+b])
+    xs = (x_lo + x_hi).reshape(t, 16, nb).sum(axis=1)          # (t, nb)
+    corr = -8.0 * jnp.einsum("tb,db->td", xs, w.scales.astype(jnp.float32))
+    x_lo = x_lo.astype(jnp.bfloat16)
+    x_hi = x_hi.astype(jnp.bfloat16)
+    packed2d = w.packed.reshape(d, m)
+    grid = (d // td,)
+    out = pl.pallas_call(
+        functools.partial(_kernel_b, nb=nb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, td), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t, td), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+    )(x_lo, x_hi, packed2d, w.scales.astype(jnp.float32), corr)
+    return out
+
+
+# ---- harness ---------------------------------------------------------------
+
+def check(name, fn):
+    w1 = _q40(256, 512, layers=1)
+    w1 = QuantizedTensor(w1.packed[0], w1.scales[0])
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 512), np.float32))
+    want = x @ dequantize_q40_jax(w1, jnp.float32).T
+    got = fn(x, w1)
+    err = float(jnp.max(jnp.abs(want - got)))
+    rel = err / float(jnp.max(jnp.abs(want)))
+    print(f"{name}: max rel err {rel:.2e}")
+    assert rel < 2e-2, f"{name} wrong"
+
+
+def bench(name, fn, td=256):
+    w = _q40(H, D)
+    x = jnp.ones((1, D), jnp.bfloat16)
+
+    def make(reps):
+        def run(w, x):
+            def rep(x, _):
+                def layer(x, wl):
+                    y = fn(x, wl, td)
+                    return x + y[:, :D].astype(x.dtype) * jnp.bfloat16(1e-6), None
+                x, _ = jax.lax.scan(layer, x, w)
+                return x, None
+            x, _ = jax.lax.scan(rep, x, None, length=reps)
+            return x
+        return jax.jit(run)
+
+    dt = slope(make, w, x)
+    gb = (w.packed.size + w.scales.size * 2) / 1e9
+    print(f"{name} (td={td}): {dt*1e3:.3f} ms/pass for {gb:.2f} GB -> {gb/dt:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "a"):
+        check("A", lambda x, w: q40_matmul_a(x, w))
+        bench("A bf16", q40_matmul_a, 256)
+        bench("A bf16", q40_matmul_a, 512)
+    if which in ("all", "b"):
+        check("B", lambda x, w: q40_matmul_b(x, w))
+        bench("B bf16+corr", q40_matmul_b, 256)
+        bench("B bf16+corr", q40_matmul_b, 512)

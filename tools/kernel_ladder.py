@@ -1,0 +1,125 @@
+"""Cost ladder for the Q40 kernel: add one stage at a time, measure each.
+
+Stages: read (DMA only) -> unpack -> convert -> scale-mul -> dots.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
+
+L, D, H = 32, 4096, 11008
+R1, R2 = 2, 10
+TD = 256
+
+
+def slope(make_run, *args):
+    ts = {}
+    for reps in (R1, R2):
+        fn = make_run(reps)
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            np.asarray(jax.tree.leaves(out)[0])
+            best = min(best, time.perf_counter() - t0)
+        ts[reps] = best
+    return (ts[R2] - ts[R1]) / (R2 - R1)
+
+
+def make_kernel(stage):
+    def kernel(x_lo_ref, x_hi_ref, packed_ref, scales_ref, out_ref, *, nb):
+        t = x_lo_ref.shape[0]
+        td = packed_ref.shape[0]
+        if stage == "read":
+            # touch one lane of the block so the DMA isn't elided
+            out_ref[:] = jnp.broadcast_to(
+                packed_ref[0:1, 0:1].astype(jnp.int32).astype(jnp.float32)
+                + scales_ref[0:1, 0:1],
+                out_ref.shape)
+            return
+        pk = packed_ref[:].astype(jnp.int32)
+        if stage == "unpack":
+            lo = pk & 0xF
+            hi = pk >> 4
+            out_ref[:] = jnp.broadcast_to(
+                (lo[0:1, 0:1] + hi[0:1, 0:1]).astype(jnp.float32), out_ref.shape)
+            return
+        lo = (pk & 0xF).astype(jnp.float32)
+        hi = (pk >> 4).astype(jnp.float32)
+        if stage == "convert":
+            out_ref[:] = jnp.broadcast_to(lo[0:1, 0:1] + hi[0:1, 0:1], out_ref.shape)
+            return
+        s16 = pltpu.repeat(scales_ref[:], 16, axis=1)
+        wlo = lo * s16
+        whi = hi * s16
+        if stage == "mul":
+            out_ref[:] = jnp.broadcast_to(wlo[0:1, 0:1] + whi[0:1, 0:1], out_ref.shape)
+            return
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[:] = dot(x_lo_ref[:], wlo) + dot(x_hi_ref[:], whi)
+    return kernel
+
+
+def run_stage(stage):
+    rng = np.random.default_rng(0)
+    nb = D // 32
+    m = 16 * nb
+    packed = jnp.asarray(rng.integers(0, 256, (L, H, m), dtype=np.uint8))
+    scales = jnp.asarray((rng.random((L, H, nb), dtype=np.float32) * 0.004))
+    t = 1
+    x_lo = jnp.ones((t, m), jnp.float32)
+    x_hi = jnp.ones((t, m), jnp.float32)
+
+    kern = make_kernel(stage)
+
+    def one(p2, s2, x_lo, x_hi):
+        return pl.pallas_call(
+            functools.partial(kern, nb=nb),
+            grid=(H // TD,),
+            in_specs=[
+                pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((TD, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((TD, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((t, TD), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((t, H), jnp.float32),
+        )(x_lo, x_hi, p2, s2)
+
+    def make(reps):
+        def run(packed, scales, x):
+            def rep(x, _):
+                def layer(x, ws):
+                    p2, s2 = ws
+                    y = one(p2, s2, x, x)
+                    return x + y[:, :m] * 1e-6, None
+                x, _ = jax.lax.scan(layer, x, (packed, scales))
+                return x, None
+            x, _ = jax.lax.scan(rep, x, None, length=reps)
+            return x
+        return jax.jit(run)
+
+    dt = slope(make, packed, scales, x_lo)
+    gb = (packed.size + scales.size * 2) / 1e9
+    print(f"{stage:8s}: {dt*1e3:.3f} ms/pass -> {gb/dt:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    for stage in (sys.argv[1:] or ["read", "unpack", "convert", "mul", "dot"]):
+        run_stage(stage)

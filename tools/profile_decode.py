@@ -1,0 +1,72 @@
+"""Decode-path profiler: A/B the weight-read strategies on the real chip.
+
+Establishes the roofline picture VERDICT asked for:
+  * packed Q40 bytes/token and dense-bf16 bytes/token for the chosen model
+  * measured ms/token per mode -> effective HBM bandwidth
+Modes: q40_xla (dequant-in-XLA), q40_pallas (fused kernel), dense_bf16.
+
+Usage: PROF_MODE=q40_xla PROF_LAYERS=32 PROF_TOKENS=32 python tools/profile_decode.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import LLAMA2_7B, synth_q40_params
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor, dequantize_q40_jax
+from distributed_llama_tpu.runtime.engine import Engine
+
+
+def model_bytes(params: dict, dense_bytes_per_el: int | None = None) -> int:
+    total = 0
+    for w in jax.tree.leaves(params):
+        total += w.size * w.dtype.itemsize
+    return total
+
+
+def main() -> None:
+    mode = os.environ.get("PROF_MODE", "q40_xla")
+    n_layers = int(os.environ.get("PROF_LAYERS", "32"))
+    n_tokens = int(os.environ.get("PROF_TOKENS", "32"))
+    seq_len = int(os.environ.get("PROF_SEQ", "2048"))
+
+    spec = dataclasses.replace(LLAMA2_7B, n_layers=n_layers)
+    params = synth_q40_params(spec)
+
+    if mode == "dense_bf16":
+        params = jax.tree.map(
+            lambda v: dequantize_q40_jax(v, jnp.bfloat16) if isinstance(v, QuantizedTensor) else v,
+            params, is_leaf=lambda v: isinstance(v, QuantizedTensor))
+
+    engine = Engine(
+        spec, params,
+        compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
+        max_seq_len=seq_len,
+        use_pallas=(mode == "q40_pallas"),
+    )
+
+    _, dt = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
+    ms = dt / n_tokens * 1e3
+
+    wbytes = model_bytes(engine.params)
+    cache_bytes = sum(k.size * k.dtype.itemsize for k in engine.cache.k) * 2
+    eff_bw = (wbytes + cache_bytes) / (ms / 1e3) / 1e9
+
+    print(json.dumps({
+        "mode": mode, "layers": n_layers, "tokens": n_tokens,
+        "ms_per_token": round(ms, 3),
+        "weight_gb": round(wbytes / 1e9, 3),
+        "cache_gb": round(cache_bytes / 1e9, 3),
+        "eff_bw_gbps": round(eff_bw, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
