@@ -68,11 +68,15 @@ def _completion_chunks(state: ApiState, body: dict):
             f"prompt is {len(tokens)} tokens; context is {engine.seq_len}")
 
     # per-request sampler params must not leak into later requests that omit
-    # them — the server default is restored in the finally below
+    # them — temperature AND the RNG stream position are restored in the
+    # finally below (a request's "seed" must not permanently reseed the
+    # shared sampler)
     saved_temp = sampler.temperature
+    saved_rng_state = None
     if body.get("temperature") is not None:
         sampler.set_temp(float(body["temperature"]))
     if body.get("seed") is not None:
+        saved_rng_state = sampler.rng_state
         sampler.set_seed(int(body["seed"]))
 
     limit = engine.seq_len - len(tokens) - 1
@@ -109,6 +113,8 @@ def _completion_chunks(state: ApiState, body: dict):
             logits = engine.step(np.asarray([[tok]], np.int32), engine.pos)
     finally:
         sampler.set_temp(saved_temp)
+        if saved_rng_state is not None:
+            sampler.rng_state = saved_rng_state
     yield ("done", {"finish_reason": finish,
                     "prompt_tokens": n_prompt,
                     "completion_tokens": emitted})

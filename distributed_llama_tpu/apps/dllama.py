@@ -112,6 +112,7 @@ def build_engine(args):
         max_seq_len=args.max_seq_len,
         compute_dtype=cdt, cache_dtype=kdt,
         activation_q80=(args.buffer_float_type == "q80" and mode == "q40"),
+        q80_collectives=(args.buffer_float_type == "q80"),
         use_pallas=bool(args.pallas),
     )
 
@@ -145,7 +146,7 @@ def cmd_generate(args, benchmark: bool) -> None:
         prev[0] = tok
 
     res = engine.generate(tokens, _steps(args, engine), sampler,
-                          eos_id=tokenizer.eos_id, on_token=on_token)
+                          eos_id=tokenizer.stop_token_ids(), on_token=on_token)
     print()
     if benchmark:
         # per-token G/I lines + averages (ref: dllama.cpp:47-48,74-91)
@@ -186,9 +187,10 @@ def cmd_chat(args) -> None:
         tokens = tokenizer.encode(text, add_bos=True)
         print("\n🤖 Assistant")
         prev = [tokens[-1]]
+        stops = tokenizer.stop_token_ids()
 
         def on_token(tok: int) -> None:
-            if tok != tokenizer.eos_id:
+            if tok not in stops:
                 _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
             prev[0] = tok
 
@@ -198,7 +200,7 @@ def cmd_chat(args) -> None:
             print("(context window full)")
             break
         engine.generate(tokens, min(_steps(args, engine), remaining), sampler,
-                        eos_id=tokenizer.eos_id, on_token=on_token)
+                        eos_id=stops, on_token=on_token)
         print()
 
 

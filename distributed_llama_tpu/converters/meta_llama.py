@@ -54,11 +54,16 @@ def _meta_name(plan_name: str) -> str:
 
 
 def convert_meta(folder: str, out_path: str, weights_float_type: FloatType,
-                 seq_len: int = 2048, progress: bool = True) -> ModelSpec:
+                 seq_len: int | None = None, progress: bool = True) -> ModelSpec:
+    """seq_len=None reads max_seq_len from params.json (the reference
+    converter requires and uses it — ref: convert-llama.py:59-62), falling
+    back to 2048 for checkpoints that omit it; pass a value to override."""
     import torch
 
     with open(os.path.join(folder, "params.json")) as f:
         params = json.load(f)
+    if seq_len is None:
+        seq_len = int(params.get("max_seq_len", 2048))
 
     shard_paths = sorted(Path(folder).glob("consolidated.*.pth"))
     if not shard_paths:
@@ -115,9 +120,9 @@ def main(argv=None) -> None:
     ap.add_argument("output")
     ap.add_argument("--weights-float-type", default="q40",
                     choices=["f32", "f16", "q40", "q80"])
-    ap.add_argument("--seq-len", type=int, default=2048,
-                    help="context length written to the header (Meta "
-                         "params.json does not record it)")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="context length written to the header (default: "
+                         "params.json max_seq_len, else 2048)")
     args = ap.parse_args(argv)
     spec = convert_meta(args.folder, args.output,
                         FloatType[args.weights_float_type.upper()], args.seq_len)

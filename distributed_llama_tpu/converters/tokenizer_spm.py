@@ -82,8 +82,15 @@ def spm_to_tokenizer_data(path: str, bos_id: int = 1, eos_id: int = 2) -> Tokeni
     pieces = parse_spm_model(path)
     vocab: list[bytes] = []
     scores: list[float] = []
-    for piece, score, ptype in pieces:
+    for i, (piece, score, ptype) in enumerate(pieces):
         text = piece.decode("utf-8", errors="replace")
+        # bos/eos pieces are rewritten to the llama2.c display convention the
+        # reference exporter uses, keeping .t files byte-compatible with its
+        # output (ref: convert-tokenizer-sentencepiece.py:42-45)
+        if i == bos_id:
+            text = "\n<s>\n"
+        elif i == eos_id:
+            text = "\n</s>\n"
         # SPM word-boundary marker U+2581 -> leading space (llama2.c convention)
         text = text.replace("▁", " ")
         vocab.append(text.encode("utf-8"))

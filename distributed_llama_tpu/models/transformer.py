@@ -193,7 +193,12 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
 
 
 def _take_expert(w, e):
-    """Select expert e from a stacked (E, ...) weight (dense or Q40)."""
+    """Select expert e from a stacked (E, ...) weight (dense or Q40; for
+    TpColWeight the expert axis sits behind the tp stack axis)."""
+    from ..parallel.tp_q80 import TpColWeight, take_expert_col
+
+    if isinstance(w, TpColWeight):
+        return take_expert_col(w, e)
     if isinstance(w, QuantizedTensor):
         return QuantizedTensor(
             lax.dynamic_index_in_dim(w.packed, e, axis=0, keepdims=False),
@@ -236,6 +241,7 @@ def forward(
     logits_for_all: bool = False,
     use_pallas: bool = False,
     sp_mesh=None,
+    tp_mesh=None,
     logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
@@ -245,9 +251,11 @@ def forward(
     logits_for_all.
     sp_mesh: a Mesh whose sp axis shards this segment's sequence — enables the
     ring-attention prefill path (segment must start at pos 0).
+    tp_mesh: a Mesh for the q80-collective TP mode (col weights repacked as
+    TpColWeight; see parallel/tp_q80.py).
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
-               use_pallas=use_pallas)
+               use_pallas=use_pallas, tp_mesh=tp_mesh)
     b, t = tokens.shape
 
     x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203

@@ -30,6 +30,7 @@ def matmul(
     activation_q80: bool = False,
     compute_dtype=jnp.float32,
     use_pallas: bool = False,
+    tp_mesh=None,
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
@@ -39,12 +40,22 @@ def matmul(
 
     use_pallas=True routes Q40 weights through the fused dequant-matmul TPU
     kernel (ops/pallas_q40.py) when its layout preconditions hold.
+
+    tp_mesh: mesh for the q80-collective mode — col-split weights arrive as
+    TpColWeight stacks and run the shard_map partial-sum path with the
+    Q80-compressed all-reduce (parallel/tp_q80.py).
     """
     if activation_q80:
         q, scales = quantize_q80_jax(x)
         x = dequantize_q80_jax(q, scales, dtype=compute_dtype)
     else:
         x = x.astype(compute_dtype)
+
+    from ..parallel.tp_q80 import TpColWeight, tp_col_matmul
+
+    if isinstance(w, TpColWeight):
+        assert tp_mesh is not None, "TpColWeight requires the mesh in cfg"
+        return tp_col_matmul(x, w, tp_mesh, compute_dtype=compute_dtype)
 
     if isinstance(w, QuantizedTensor):
         if use_pallas:

@@ -1,6 +1,12 @@
 from .mesh import make_mesh, TP_AXIS, DP_AXIS, SP_AXIS
-from .sharding import param_pspecs, shard_params, cache_pspec, check_tp_constraints
-from .collectives import q80_psum, q80_all_gather
+from .sharding import (
+    param_pspecs,
+    shard_params,
+    cache_pspec,
+    check_tp_constraints,
+    repack_col_weights,
+)
+from .collectives import q80_psum, q80_all_gather, q80_psum_2shot
 
 __all__ = [
     "make_mesh",
@@ -11,6 +17,8 @@ __all__ = [
     "shard_params",
     "cache_pspec",
     "check_tp_constraints",
+    "repack_col_weights",
     "q80_psum",
     "q80_all_gather",
+    "q80_psum_2shot",
 ]

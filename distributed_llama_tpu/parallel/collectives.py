@@ -36,6 +36,43 @@ def q80_psum(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     Equivalent of the reference's quantize -> gather -> dequantize -> sum
     (ref: src/tasks.cpp:67-90,149-163 + llama2-tasks.cpp:125-131), with the
     star topology replaced by an all-gather so every shard gets the result.
+
+    Per-device wire bytes: (n-1) * 1.0625*|x| — fine at n=2, beaten by
+    `q80_psum_2shot` for larger meshes (which stays ~2*1.0625*|x|).
     """
     gathered = q80_all_gather(x, axis_name)  # (shards, ..., n)
     return jnp.sum(gathered, axis=0).astype(x.dtype)
+
+
+def q80_psum_2shot(x: jnp.ndarray, axis_name: str, n: int) -> jnp.ndarray:
+    """Two-shot quantized all-reduce: int8 all-to-all of per-destination
+    chunks -> local dequant + f32 sum -> re-quantize -> int8 all-gather.
+
+    The distributed form of the reference's gather-at-root + sum + rebroadcast
+    (ref: src/tasks.cpp:67-163) with the root role rotated: device i owns the
+    reduction of chunk i. Per-device wire bytes 2*(n-1)/n * 1.0625*|x| vs
+    2*(n-1)/n * 4*|x| for an f32 ring all-reduce — the reference's ~4x wire
+    cut (ref README.md:96-110) at every mesh size, where the one-shot
+    `q80_psum` degrades past n=4. Values are quantized twice (partial sums,
+    then the reduced chunk) — the same double quantization the reference's
+    Q80 buffer performs per hop.
+
+    `n` must be the static size of `axis_name`; the last dim of x must split
+    into n chunks of whole 32-element blocks (fall back to q80_psum if not).
+    """
+    d = x.shape[-1]
+    if n == 1:
+        return x
+    if d % (32 * n) != 0:
+        return q80_psum(x, axis_name)
+    lead = x.shape[:-1]
+    xc = jnp.moveaxis(x.reshape(*lead, n, d // n), -2, 0)   # (n, ..., d/n)
+    q, s = quantize_q80_jax(xc)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    red = jnp.sum(dequantize_q80_jax(q, s), axis=0)         # my chunk, reduced
+    q2, s2 = quantize_q80_jax(red)
+    qg = jax.lax.all_gather(q2, axis_name)
+    sg = jax.lax.all_gather(s2, axis_name)
+    out = jnp.moveaxis(dequantize_q80_jax(qg, sg), 0, -2).reshape(*lead, d)
+    return out.astype(x.dtype)

@@ -75,6 +75,11 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
 
 
 def _leaf_spec(name: str, w):
+    from .tp_q80 import TpColWeight, tp_col_pspec
+
+    if isinstance(w, TpColWeight):
+        # q80-collective mode: col weights are pre-stacked (tp, ..., d, n/tp)
+        return tp_col_pspec(w)
     if isinstance(w, QuantizedTensor):
         return QuantizedTensor(  # pytree-shaped specs
             _pspec_for(name, w.packed.ndim, True, "packed"),
@@ -117,6 +122,29 @@ def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:
         assert spec.dim % (32 * tp) == 0
 
 
+COL_SPLIT_NAMES = tuple(k for k, v in _SPLIT.items() if v == "col")
+
+
+def repack_col_weights(params: dict, tp: int) -> dict:
+    """Repack every col-split weight into the TpColWeight stacked form used
+    by the q80-collective shard_map path (parallel/tp_q80.py). Non-mutating
+    (callers may keep using the original pytree, e.g. to compare modes).
+
+    Note: on device-resident weights this transiently duplicates each col
+    weight on the default device before shard_params distributes it; the
+    streamed loader (models/loader.py) repacks host-side per tensor and
+    places shards directly, avoiding the spike — prefer it at 70B scale."""
+    from .tp_q80 import repack_col_tp
+
+    out = dict(params)
+    out["layers"] = [
+        {k: (repack_col_tp(v, tp) if k in COL_SPLIT_NAMES else v)
+         for k, v in lw.items()}
+        for lw in params["layers"]
+    ]
+    return out
+
+
 def shard_params(params: dict, mesh) -> dict:
     """device_put every leaf with its NamedSharding (sharded weight placement —
     the analogue of the reference's per-worker weight push at load,
@@ -127,6 +155,10 @@ def shard_params(params: dict, mesh) -> dict:
         return jax.device_put(w, NamedSharding(mesh, s))
 
     def put_entry(w, sp):
+        from .tp_q80 import TpColWeight
+
+        if isinstance(w, TpColWeight):
+            return TpColWeight(put_entry(w.w, sp.w))
         if isinstance(w, QuantizedTensor):
             return QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))
         return put(w, sp)

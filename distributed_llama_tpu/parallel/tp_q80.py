@@ -1,0 +1,137 @@
+"""Q80-compressed tensor-parallel col-split matmul (shard_map path).
+
+The reference quantizes every inter-node activation transfer to Q80 int8
+blocks (ref: src/tasks.cpp:124-163), invoked around each layer's wo/w2
+partial-sum exchange (ref: src/llama2-tasks.cpp:251-274) — its signature
+wire optimization (README measures 2048 kB -> 544 kB per token). Under pure
+GSPMD the col-split contraction's all-reduce is compiler-inserted and always
+exact/full-precision; this module is the explicit execution path where that
+reduction moves int8 blocks instead, selected by `--buffer-float-type q80`.
+
+Layout: a col-split weight (wo, w2, moe_down — ref ColMatmulSlice,
+src/transformer.cpp:48-76) is repacked host/device-side into a stacked
+(tp, ..., d, n/tp) form where slice k quantization-block-aligns with logical
+input columns [k*n/tp, (k+1)*n/tp). The stack is sharded P('tp', ...) so
+each device holds exactly its slice; inside `shard_map` the local partial
+matmul runs on block-aligned Q40 data (no GSPMD re-tiling of packed bytes),
+and the partial sums reduce via the two-shot quantized all-reduce
+(parallel/collectives.py:q80_psum_2shot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..quants.jax_codec import QuantizedTensor, dequantize_q40_jax
+from .collectives import q80_psum_2shot
+from .mesh import DP_AXIS, SP_AXIS, TP_AXIS
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TpColWeight:
+    """A col-split weight repacked as a (tp, ..., d, n/tp) stack.
+
+    `w` is a dense array or a QuantizedTensor whose packed/scales carry the
+    same leading tp axis. Slice k holds the weight columns contracting with
+    input columns [k*n/tp, (k+1)*n/tp) — the reference's ColMatmulSlice shard
+    for node k (ref: src/transformer.cpp:60-76)."""
+
+    w: QuantizedTensor | jax.Array
+
+    def tree_flatten(self):
+        return (self.w,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def repack_col_tp(w, tp: int) -> TpColWeight:
+    """Split a col-split weight into a block-aligned per-shard stack.
+
+    Dense (..., d, n) -> (tp, ..., d, n/tp). Q40 packed (..., d, 16*nb) with
+    lane order m = j*nb + b (quants/jax_codec.py) -> per-shard lane order
+    m_local = j*(nb/tp) + b_local, i.e. each shard is itself a valid flattened
+    QuantizedTensor for its logical column range — a pure relayout of the
+    existing bytes (blocks never straddle shards because n/tp % 32 == 0,
+    checked in sharding.check_tp_constraints)."""
+    if isinstance(w, QuantizedTensor):
+        nb = w.scales.shape[-1]
+        assert nb % tp == 0, (nb, tp)
+        lead = w.packed.shape[:-1]
+        pk = w.packed.reshape(*lead, 16, tp, nb // tp)
+        pk = jnp.moveaxis(pk, -2, 0).reshape(tp, *lead, 16 * (nb // tp))
+        sc = jnp.moveaxis(w.scales.reshape(*lead, tp, nb // tp), -2, 0)
+        return TpColWeight(QuantizedTensor(pk, sc))
+    n = w.shape[-1]
+    assert n % tp == 0, (n, tp)
+    return TpColWeight(jnp.moveaxis(w.reshape(*w.shape[:-1], tp, n // tp), -2, 0))
+
+
+def tp_col_pspec(w: TpColWeight):
+    """PartitionSpec pytree for a TpColWeight: leading stack axis on tp."""
+    def spec(ndim):
+        return P(TP_AXIS, *([None] * (ndim - 1)))
+
+    if isinstance(w.w, QuantizedTensor):
+        return TpColWeight(QuantizedTensor(spec(w.w.packed.ndim), spec(w.w.scales.ndim)))
+    return TpColWeight(spec(w.w.ndim))
+
+
+def take_expert_col(w: TpColWeight, e) -> TpColWeight:
+    """Select expert e from a stacked (tp, E, d, n/tp) MoE col weight."""
+    from jax import lax
+
+    if isinstance(w.w, QuantizedTensor):
+        return TpColWeight(QuantizedTensor(
+            lax.dynamic_index_in_dim(w.w.packed, e, axis=1, keepdims=False),
+            lax.dynamic_index_in_dim(w.w.scales, e, axis=1, keepdims=False),
+        ))
+    return TpColWeight(lax.dynamic_index_in_dim(w.w, e, axis=1, keepdims=False))
+
+
+def tp_col_matmul(
+    x: jnp.ndarray,
+    w: TpColWeight,
+    mesh,
+    *,
+    compute_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """y[b, t, d] = sum_n x[b, t, n] * W[d, n] with the contraction tp-split
+    and the partial-sum reduction Q80-compressed.
+
+    x is a global (B, T, n) array (GSPMD-resident); the shard_map forces the
+    last dim onto tp (matching how row-split producers already shard it), the
+    local (B_l, T_l, n/tp) x slice contracts with this shard's weight slice,
+    and partials all-reduce via the quantized two-shot exchange. Output is
+    (B, T, d), replicated over tp like the GSPMD-exact path's all-reduce."""
+    from jax import shard_map
+
+    tp = mesh.shape[TP_AXIS]
+    b, t, _ = x.shape
+    dp = mesh.shape.get(DP_AXIS, 1)
+    sp = mesh.shape.get(SP_AXIS, 1)
+    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
+    sp_ax = SP_AXIS if sp > 1 and t > 1 and t % sp == 0 else None
+    x_spec = P(dp_ax, sp_ax, TP_AXIS)
+    out_spec = P(dp_ax, sp_ax, None)
+
+    def body(x_l, w_l):
+        wk = w_l.w
+        if isinstance(wk, QuantizedTensor):
+            wk = QuantizedTensor(wk.packed[0], wk.scales[0])
+            wd = dequantize_q40_jax(wk, dtype=compute_dtype)
+        else:
+            wd = wk[0].astype(compute_dtype)
+        partial = jnp.einsum("btn,dn->btd", x_l.astype(compute_dtype), wd,
+                             preferred_element_type=compute_dtype)
+        return q80_psum_2shot(partial, TP_AXIS, tp)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(x_spec, tp_col_pspec(w)),
+                   out_specs=out_spec, check_vma=False)
+    return fn(x, w)

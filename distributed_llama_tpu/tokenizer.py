@@ -36,6 +36,21 @@ class Tokenizer:
     def vocab_size(self) -> int:
         return len(self.vocab)
 
+    # end-of-turn pieces emitted by instruct-tuned models whose header eos_id
+    # is the base-model eos (e.g. llama-3: eos=<|end_of_text|> while chat
+    # turns end with <|eot_id|>/<|eom_id|>)
+    CHAT_STOP_PIECES = (b"<|eot_id|>", b"<|eom_id|>")
+
+    def stop_token_ids(self) -> set[int]:
+        """eos_id plus any end-of-turn marker tokens present in the vocab —
+        the id set generation should stop on."""
+        ids = {self.eos_id}
+        for piece in self.CHAT_STOP_PIECES:
+            tid = self._index.get(piece)
+            if tid is not None:
+                ids.add(tid)
+        return ids
+
     def encode(self, text: str, add_bos: bool = True, add_eos: bool = False) -> list[int]:
         tokens: list[int] = []
         if add_bos:

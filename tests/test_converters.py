@@ -139,6 +139,9 @@ def test_spm_parser_and_convert(tmp_path):
     data = spm_to_tokenizer_data(path)
     assert data.vocab[3] == b" hi"  # U+2581 -> space
     assert data.vocab_size == 5 and data.bos_id == 1 and data.eos_id == 2
+    # bos/eos pieces rewritten to the reference exporter's display form
+    # (ref: convert-tokenizer-sentencepiece.py:42-45)
+    assert data.vocab[1] == b"\n<s>\n" and data.vocab[2] == b"\n</s>\n"
 
 
 def test_llama3_tokenizer_convert(tmp_path):
@@ -151,7 +154,16 @@ def test_llama3_tokenizer_convert(tmp_path):
     data = llama3_to_tokenizer_data(path)
     assert data.vocab[:4] == toks
     assert data.vocab_size == 4 + 256
-    # merge priority: lower rank -> higher score
+    # merge priority: lower rank -> higher score; specials continue the
+    # -rank sequence (reference parity)
     assert data.scores[0] > data.scores[3]
+    assert data.scores[4] == -4.0
+    # reference special-token table + base-model eos (<|end_of_text|>)
     assert data.vocab[data.bos_id] == b"<|begin_of_text|>"
-    assert data.vocab[data.eos_id] == b"<|eot_id|>"
+    assert data.vocab[data.eos_id] == b"<|end_of_text|>"
+    assert data.vocab[4 + 9] == b"<|eot_id|>"
+    assert data.vocab[4 + 8] == b"<|reserved_special_token_4|>"
+    assert data.vocab[-1] == b"<|reserved_special_token_250|>"
+    # instruct override
+    inst = llama3_to_tokenizer_data(path, eos_id=4 + 9)
+    assert inst.vocab[inst.eos_id] == b"<|eot_id|>"

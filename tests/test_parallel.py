@@ -139,6 +139,96 @@ def test_q80_psum_matches_psum():
     assert np.abs(a - b).max() < 8 * np.abs(x).max() / 127 * 1.1
 
 
+def test_q80_psum_2shot_matches_psum():
+    """Two-shot quantized all-reduce ~ exact all-reduce; chunk-block-aligned
+    path (the wire-efficient form of the reference's Q80 exchange)."""
+    from jax import shard_map
+
+    from distributed_llama_tpu.parallel import q80_psum_2shot
+
+    mesh = make_mesh(tp=8)
+    # last dim 512 = 8 shards x 2 blocks: exercises the all_to_all path
+    x = np.random.default_rng(1).standard_normal((8, 4, 512)).astype(np.float32)
+
+    @jax.jit
+    def exact(x):
+        f = shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+                      in_specs=P("tp"), out_specs=P(), check_vma=False)
+        return f(x)
+
+    @jax.jit
+    def quantized(x):
+        f = shard_map(lambda v: q80_psum_2shot(v[0], "tp", 8)[None], mesh=mesh,
+                      in_specs=P("tp"), out_specs=P(), check_vma=False)
+        return f(x)
+
+    a = np.asarray(exact(x))
+    b = np.asarray(quantized(x))
+    # double quantization (partials + reduced chunk): 2x the one-shot bound
+    assert np.abs(a - b).max() < 2 * 8 * np.abs(x).max() / 127 * 1.1
+
+
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
+@pytest.mark.parametrize("mode", ["dense", "q40"])
+def test_tp_q80_collectives_match_exact(arch, mode):
+    """q80-collective TP forward (shard_map + quantized all-reduce on wo/w2)
+    ~ GSPMD-exact TP forward within block-quant tolerance (VERDICT r1 #2;
+    ref wire compression: src/tasks.cpp:124-163)."""
+    from distributed_llama_tpu.parallel.tp_q80 import TpColWeight
+
+    spec = make_spec(arch, dim=256, n_heads=8, n_kv_heads=4, hidden_dim=512)
+    host, _ = dense_weights(spec, seed=11)
+    params = load_params(spec, host, mode=mode, dtype=jnp.float32)
+    mesh = make_mesh(tp=4)
+
+    exact = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                   cache_dtype=jnp.float32)
+    q80 = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, q80_collectives=True)
+    # col weights actually repacked into the shard_map stacked form
+    assert isinstance(q80.params["layers"][0]["wo"], TpColWeight)
+    if arch == ArchType.MIXTRAL:
+        assert isinstance(q80.params["layers"][0]["moe_down"], TpColWeight)
+
+    toks = [7, 3, 1]
+    for i, t in enumerate(toks):
+        a = np.asarray(exact.step(np.array([[t]], np.int32), i))
+        b = np.asarray(q80.step(np.array([[t]], np.int32), i))
+        # per-layer quantized exchange: error bounded by a few block-quant
+        # steps on the residual stream; logits stay close
+        np.testing.assert_allclose(b, a, rtol=0, atol=0.05)
+        assert np.argmax(a) == np.argmax(b)
+
+
+def test_repack_col_tp_roundtrip():
+    """The stacked (tp, d, n/tp) shards hold exactly the logical column
+    slices of the original weight, for dense and Q40 forms."""
+    from distributed_llama_tpu.parallel.tp_q80 import repack_col_tp
+    from distributed_llama_tpu.quants.jax_codec import dequantize_q40_jax
+    from distributed_llama_tpu.quants.numpy_codec import quantize_q40
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((16, 256), dtype=np.float32) * 0.1
+    tp = 4
+
+    # dense
+    stacked = repack_col_tp(jnp.asarray(w), tp).w
+    for k in range(tp):
+        np.testing.assert_array_equal(np.asarray(stacked[k]),
+                                      w[:, k * 64:(k + 1) * 64])
+
+    # q40: per-shard dequant == dequant-of-slice
+    scales, packed = quantize_q40(w)
+    qt = QuantizedTensor.from_numpy(scales, packed)
+    full = np.asarray(dequantize_q40_jax(qt, dtype=jnp.float32))
+    stacked_q = repack_col_tp(qt, tp).w
+    for k in range(tp):
+        shard = QuantizedTensor(stacked_q.packed[k], stacked_q.scales[k])
+        np.testing.assert_allclose(
+            np.asarray(dequantize_q40_jax(shard, dtype=jnp.float32)),
+            full[:, k * 64:(k + 1) * 64], rtol=0, atol=1e-6)
+
+
 def test_engine_generate_greedy():
     spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
     host, _ = dense_weights(spec, seed=9)

@@ -93,3 +93,17 @@ def test_sampler_seed_reproducible():
     seq_a = [a.sample(logits.copy()) for _ in range(10)]
     seq_b = [b.sample(logits.copy()) for _ in range(10)]
     assert seq_a == seq_b
+
+
+def test_stop_token_ids_include_chat_markers():
+    from distributed_llama_tpu.io.tokenizer_file import TokenizerData
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    vocab = [b"<unk>", b"<s>", b"</s>", b"a", b"<|eot_id|>", b"<|eom_id|>"]
+    t = Tokenizer(TokenizerData(vocab=vocab, scores=[0.0] * 6, bos_id=1, eos_id=2))
+    # eos plus every end-of-turn marker present in the vocab (llama-3 instruct
+    # ends turns with <|eot_id|> while eos_id is the base-model eos)
+    assert t.stop_token_ids() == {2, 4, 5}
+
+    t2 = Tokenizer(TokenizerData(vocab=vocab[:4], scores=[0.0] * 4, bos_id=1, eos_id=2))
+    assert t2.stop_token_ids() == {2}
