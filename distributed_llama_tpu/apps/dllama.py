@@ -139,6 +139,26 @@ def cmd_generate(args, benchmark: bool) -> None:
     tokens = tokenizer.encode(prompt)
     print(f"💡 prompt tokens: {len(tokens)}")
 
+    if engine.batch > 1:
+        # dp throughput mode: the batch rows generate independently (here the
+        # same prompt replicated); row 0 streams to stdout
+        t0 = time.time()
+        outs = engine.generate_batch([tokens] * engine.batch,
+                                     _steps(args, engine), sampler,
+                                     eos_id=tokenizer.stop_token_ids())
+        dt = time.time() - t0
+        prev_t = tokens[-1]
+        for tok in outs[0]:
+            _safe_print(tokenizer.decode_piece(prev_t, tok).decode(
+                "utf-8", errors="replace"))
+            prev_t = tok
+        print()
+        if benchmark:
+            n = sum(len(o) for o in outs)
+            print(f"Generated tokens:    {n} ({engine.batch} sequences)")
+            print(f"Avg tokens / second: {n / max(dt, 1e-9):.2f}")
+        return
+
     prev = [tokens[-1]]
 
     def on_token(tok: int) -> None:

@@ -70,7 +70,7 @@ class KVCache(NamedTuple):
 
 
 def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
-                     sp_mesh=None):
+                     sp_mesh=None, sp_cache_mesh=None, per_row_pos=False):
     """Norm -> QKV -> RoPE -> cache update -> attention -> output proj.
 
     Returns (attn_out, new_k_cache, new_v_cache). attn_out is the wo
@@ -95,13 +95,32 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
     q = apply_rope(q, q_pos, spec.rope_theta, spec.arch)
     k = apply_rope(k, q_pos, spec.rope_theta, spec.arch)
 
-    # functional cache update at positions q_pos (contiguous: pos0..pos0+T);
-    # cache is head-major (B, KVH, S, hs) — see KVCache
-    pos0 = q_pos[:, 0]
-    k_cache = lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos0[0], 0))
-    v_cache = lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos0[0], 0))
+    # functional cache update at positions q_pos (contiguous per row:
+    # pos[b]..pos[b]+T); cache is head-major (B, KVH, S, hs) — see KVCache
+    if per_row_pos:
+        # batched generation: each sequence writes at its own position
+        # (net-new vs the reference's batch=1 — SURVEY.md §2.5 DP row)
+        bidx = jnp.arange(b, dtype=jnp.int32)[:, None]
+        k_cache = k_cache.at[bidx, :, q_pos].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[bidx, :, q_pos].set(v.astype(v_cache.dtype))
+    else:
+        pos0 = q_pos[:, 0]
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos0[0], 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos0[0], 0))
+    if sp_cache_mesh is not None:
+        # keep the cache sp-sharded through the functional update: during ring
+        # prefill the T-sharded K/V reshards into the S-sharded cache (one
+        # K/V-sized shuffle per layer); decode's single-position write lands
+        # in the owning shard. Per-device cache stays seq_len/sp.
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import cache_pspec
+
+        cs = NamedSharding(sp_cache_mesh, cache_pspec(sp=True))
+        k_cache = jax.lax.with_sharding_constraint(k_cache, cs)
+        v_cache = jax.lax.with_sharding_constraint(v_cache, cs)
 
     if sp_mesh is not None:
         # sequence-parallel prefill: the segment starts at pos 0 and IS the
@@ -111,6 +130,14 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
         from ..parallel.ring_attention import ring_attention
 
         att = ring_attention(q, k, v, sp_mesh, pos0=0)
+    elif sp_cache_mesh is not None:
+        # sp-sharded cache: per-chunk flash stats + exact psum merge. Must
+        # outrank the pallas branch — the pallas kernel is not shard_map'd,
+        # so routing it an sp-sharded cache would all-gather the full
+        # sequence per layer and void the seq_len/sp memory scaling.
+        from ..parallel.ring_attention import sp_cache_attention
+
+        att = sp_cache_attention(q, k_cache, v_cache, q_pos, sp_cache_mesh)
     elif t == 1 and cfg.get("use_pallas"):
         from ..ops.pallas_attention import flash_decode_attention
 
@@ -207,9 +234,11 @@ def _take_expert(w, e):
     return lax.dynamic_index_in_dim(w, e, axis=0, keepdims=False)
 
 
-def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg, sp_mesh=None):
+def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg, sp_mesh=None,
+           sp_cache_mesh=None, per_row_pos=False):
     attn_out, k_cache, v_cache = _attention_block(
-        x, lw, spec, k_cache, v_cache, q_pos, cfg, sp_mesh=sp_mesh)
+        x, lw, spec, k_cache, v_cache, q_pos, cfg, sp_mesh=sp_mesh,
+        sp_cache_mesh=sp_cache_mesh, per_row_pos=per_row_pos)
 
     if spec.arch == ArchType.GROK1:
         # post-attention norm BEFORE residual add (ref: grok1-tasks.cpp:16-41)
@@ -233,7 +262,9 @@ def forward(
     params: dict,
     spec: ModelSpec,
     tokens: jnp.ndarray,   # (B, T) int32
-    pos0: jnp.ndarray,     # scalar int32 — first absolute position of the segment
+    pos0: jnp.ndarray,     # int32 first absolute position of the segment —
+                           # scalar (shared) or (B,) per-sequence (batched
+                           # generation with ragged prompt lengths)
     cache: KVCache,
     *,
     activation_q80: bool = False,
@@ -242,17 +273,21 @@ def forward(
     use_pallas: bool = False,
     sp_mesh=None,
     tp_mesh=None,
+    sp_cache_mesh=None,
     logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
 
     logits: (B, vocab) for the last token (or position `logit_index` if
-    given — used when the segment is right-padded), or (B, T, vocab) if
-    logits_for_all.
+    given — scalar or (B,) per-sequence, used when the segment is
+    right-padded), or (B, T, vocab) if logits_for_all.
     sp_mesh: a Mesh whose sp axis shards this segment's sequence — enables the
     ring-attention prefill path (segment must start at pos 0).
     tp_mesh: a Mesh for the q80-collective TP mode (col weights repacked as
     TpColWeight; see parallel/tp_q80.py).
+    sp_cache_mesh: a Mesh whose sp axis shards the KV cache's sequence dim
+    (cache_pspec(sp=True)) — cache writes keep that sharding and attention
+    reads it chunk-wise (parallel/ring_attention.py:sp_cache_attention).
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
                use_pallas=use_pallas, tp_mesh=tp_mesh)
@@ -262,8 +297,12 @@ def forward(
     if spec.arch == ArchType.GROK1:
         x = x * GROK_INPUT_SCALE
 
-    q_pos = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
-    q_pos = jnp.broadcast_to(q_pos, (b, t))
+    per_row_pos = getattr(pos0, "ndim", 0) == 1
+    if per_row_pos:
+        q_pos = pos0[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    else:
+        q_pos = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+        q_pos = jnp.broadcast_to(q_pos, (b, t))
 
     # statically unrolled layer loop (see module docstring for why not scan)
     k_all: list = []
@@ -271,7 +310,8 @@ def forward(
     for l in range(spec.n_layers):
         x, k_new, v_new = _layer(x, params["layers"][l], spec,
                                  cache.k[l], cache.v[l], q_pos, cfg,
-                                 sp_mesh=sp_mesh)
+                                 sp_mesh=sp_mesh, sp_cache_mesh=sp_cache_mesh,
+                                 per_row_pos=per_row_pos)
         k_all.append(k_new)
         v_all.append(v_new)
 
@@ -281,7 +321,7 @@ def forward(
             x = x[:, -1, :]
         else:
             x = jnp.take_along_axis(
-                x, jnp.broadcast_to(logit_index.reshape(1, 1, 1),
+                x, jnp.broadcast_to(logit_index.reshape(-1, 1, 1),
                                     (x.shape[0], 1, x.shape[-1])), axis=1)[:, 0]
     logits = matmul(x, params["wcls"], **cfg).astype(jnp.float32)
     if spec.arch == ArchType.GROK1:

@@ -119,6 +119,59 @@ def ring_attention_local(q, k, v, chunk_pos0, axis_name: str = SP_AXIS):
     return out.astype(q.dtype)
 
 
+def sp_cache_attention(q, k_cache, v_cache, q_pos, mesh, axis_name: str = SP_AXIS):
+    """Decode/continuation attention over an sp-sharded KV cache.
+
+    The counterpart of ring_attention for steps AFTER the sequence-parallel
+    prefill: the cache's sequence dim is sharded over sp (cache_pspec(sp=True))
+    while the new queries are replicated over sp, so each device computes
+    flash stats (acc, m, l) of the full query block against its local cache
+    chunk and the stats merge exactly with a pmax/psum online-softmax
+    combination — no device ever materializes the full-sequence cache.
+
+    q: (B, T, H, hs); k_cache/v_cache: (B, KVH, S, hs) with S sharded over sp;
+    q_pos: (B, T) absolute positions (cache slots > q_pos are masked, so
+    not-yet-written positions never contribute). Returns (B, T, H, hs).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import DP_AXIS, TP_AXIS
+
+    n = mesh.shape[axis_name]
+    s = k_cache.shape[2]
+    assert s % n == 0, (s, n)
+    s_local = s // n
+    tp = TP_AXIS if TP_AXIS in mesh.axis_names else None
+    b, t, h, hs = q.shape
+    scale = 1.0 / (hs ** 0.5)
+
+    q_spec = P(DP_AXIS, None, tp, None)
+    cache_spec = P(DP_AXIS, tp, axis_name, None)
+    pos_spec = P(DP_AXIS, None)
+
+    def body(q_l, k_l, v_l, qp_l):
+        idx = lax.axis_index(axis_name)
+        bl = q_l.shape[0]
+        k_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)[None, :]
+        k_pos = jnp.broadcast_to(k_pos, (bl, s_local))
+        kt = k_l.transpose(0, 2, 1, 3)  # (B, S_l, KVH, hs) — _block_attn layout
+        vt = v_l.transpose(0, 2, 1, 3)
+        acc, m, l = _block_attn(q_l, kt, vt, qp_l, k_pos, scale)
+        # exact online-softmax merge across the sp chunks
+        m_max = lax.pmax(m, axis_name)
+        m_safe = jnp.where(jnp.isfinite(m_max), m_max, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        num = lax.psum(acc * alpha[..., None], axis_name)
+        den = lax.psum(l * alpha, axis_name)
+        return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q_l.dtype)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(q_spec, cache_spec, cache_spec, pos_spec),
+                   out_specs=q_spec, check_vma=False)
+    return fn(q, k_cache, v_cache, q_pos)
+
+
 def ring_attention(q, k, v, mesh, pos0: int = 0, axis_name: str = SP_AXIS):
     """Sequence-parallel causal attention over a mesh's sp axis.
 

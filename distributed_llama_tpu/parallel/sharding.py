@@ -100,10 +100,15 @@ def param_pspecs(params: dict) -> dict:
     return out
 
 
-def cache_pspec() -> P:
+def cache_pspec(sp: bool = False) -> P:
     """Per-layer KV cache leaf (B, KVH, S, hs): batch on dp, kv-heads on tp
-    (ref: KvCacheSlice, src/transformer.cpp:161-171)."""
-    return P(DP_AXIS, TP_AXIS, None, None)
+    (ref: KvCacheSlice, src/transformer.cpp:161-171). With sp=True the
+    sequence dim also shards over sp — per-device cache memory becomes
+    seq_len/sp, the long-context scaling axis the reference lacks
+    (SURVEY.md §5.7); decode then attends via sp_cache_attention."""
+    from .mesh import SP_AXIS
+
+    return P(DP_AXIS, TP_AXIS, SP_AXIS if sp else None, None)
 
 
 def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:

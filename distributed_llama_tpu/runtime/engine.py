@@ -70,6 +70,14 @@ class Engine:
             q80_collectives = activation_q80 and tp > 1
         self.q80_collectives = q80_collectives and tp > 1
         self._tp_mesh = mesh if self.q80_collectives else None
+        # sp > 1: the KV cache's sequence dim shards over sp (per-device
+        # cache = seq_len/sp) and every step attends via sp_cache_attention
+        sp = mesh.shape.get(SP_AXIS, 1) if mesh is not None else 1
+        if sp > 1:
+            assert self.seq_len % sp == 0, (
+                f"sp={sp} must divide max_seq_len={self.seq_len} "
+                "(sp-sharded KV cache)")
+        self._sp_cache_mesh = mesh if sp > 1 else None
         if use_pallas is None:
             # default ON for TPU: the fused kernel reads only packed bytes and
             # keeps the unpack at ~6 VPU ops/byte (measured v5e: 2.4 ms vs
@@ -99,13 +107,14 @@ class Engine:
 
                 params = repack_col_weights(params, tp)
             self.params = shard_params(params, mesh)
-            self._cache_sharding = NamedSharding(mesh, cache_pspec())
+            self._cache_sharding = NamedSharding(mesh, cache_pspec(sp=sp > 1))
             self._token_sharding = NamedSharding(mesh, P(DP_AXIS, None))
         else:
             self.params = params
             self._cache_sharding = None
             self._token_sharding = None
 
+        self._cache_maker = None
         self.cache = self._new_cache()
         self.pos = 0
         self._steps: dict[int | tuple[str, int], Callable] = {}
@@ -113,13 +122,22 @@ class Engine:
     # -- cache ------------------------------------------------------------
 
     def _new_cache(self) -> KVCache:
-        cache = KVCache.create(self.spec, self.batch, self.seq_len, self.cache_dtype)
-        if self._cache_sharding is not None:
-            cache = KVCache(
-                jax.device_put(cache.k, self._cache_sharding),
-                jax.device_put(cache.v, self._cache_sharding),
-            )
-        return cache
+        if self._cache_sharding is None:
+            return KVCache.create(self.spec, self.batch, self.seq_len,
+                                  self.cache_dtype)
+        # allocate directly into the sharded layout (out_shardings) — no
+        # transient full-size cache on one device (matters for sp-sharded
+        # long-context caches). The jitted maker is built once: reset() is a
+        # server hot path (per-request) and must not retrace.
+        if self._cache_maker is None:
+            n_l = self.spec.n_layers
+            shardings = KVCache((self._cache_sharding,) * n_l,
+                                (self._cache_sharding,) * n_l)
+            self._cache_maker = jax.jit(
+                lambda: KVCache.create(self.spec, self.batch, self.seq_len,
+                                       self.cache_dtype),
+                out_shardings=shardings)
+        return self._cache_maker()
 
     def reset(self) -> None:
         """New session: rewind position (the API server resets per request,
@@ -141,6 +159,7 @@ class Engine:
                 compute_dtype=self.compute_dtype,
                 use_pallas=self.use_pallas,
                 tp_mesh=self._tp_mesh,
+                sp_cache_mesh=self._sp_cache_mesh,
             )
 
         fn = jax.jit(run, donate_argnums=(3,))
@@ -204,6 +223,7 @@ class Engine:
                     use_pallas=self.use_pallas,
                     sp_mesh=self.mesh,
                     tp_mesh=self._tp_mesh,
+                    sp_cache_mesh=self._sp_cache_mesh,
                     logit_index=logit_index,
                 )
             self._steps[key] = jax.jit(run, donate_argnums=(3,))
@@ -263,6 +283,112 @@ class Engine:
                 on_token(token)
         return GenerationResult(out, stats)
 
+    # -- batched generation (dp path) -------------------------------------
+
+    def generate_batch(
+        self,
+        prompts: list[list[int]],
+        max_tokens: int,
+        sampler: Sampler,
+        eos_id: int | set[int] | None = None,
+    ) -> list[list[int]]:
+        """Generate for `batch` independent sequences at once (right-padded
+        prompts, per-sequence positions/eos). Net-new vs the reference's
+        batch=1 engine (SURVEY.md §2.5 DP row); with a dp mesh the batch
+        shards over dp. Greedy results match `batch` independent runs.
+
+        Returns one token list per sequence (stop token excluded)."""
+        b = len(prompts)
+        assert b == self.batch, (b, self.batch)
+        assert all(prompts), "empty prompt"
+        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        t = int(lens.max())
+        assert t < self.seq_len, "context overflow"
+
+        # whole-batch right-padded prefill; logits read at each row's last
+        # real token. Padded slots write garbage K/V at positions >= len(p),
+        # but those cache slots are overwritten by decode before any query
+        # position can attend to them (attention masks k_pos <= q_pos).
+        key = ("bpre", t)
+        if key not in self._steps:
+            def run_pre(params, tokens, logit_index, cache):
+                return forward(
+                    params, self.spec, tokens, jnp.int32(0), cache,
+                    activation_q80=self.activation_q80,
+                    compute_dtype=self.compute_dtype,
+                    use_pallas=self.use_pallas,
+                    tp_mesh=self._tp_mesh,
+                    sp_cache_mesh=self._sp_cache_mesh,
+                    logit_index=logit_index,
+                )
+            self._steps[key] = jax.jit(run_pre, donate_argnums=(3,))
+
+        vkey = ("bvec", 1)
+        if vkey not in self._steps:
+            def run_vec(params, tokens, pos_vec, cache):
+                return forward(
+                    params, self.spec, tokens, pos_vec, cache,
+                    activation_q80=self.activation_q80,
+                    compute_dtype=self.compute_dtype,
+                    use_pallas=self.use_pallas,
+                    tp_mesh=self._tp_mesh,
+                    sp_cache_mesh=self._sp_cache_mesh,
+                )
+            self._steps[vkey] = jax.jit(run_vec, donate_argnums=(3,))
+
+        padded = np.zeros((b, t), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+        tok = jnp.asarray(padded)
+        if self._token_sharding is not None:
+            tok = jax.device_put(tok, self._token_sharding)
+        logits, self.cache = self._steps[key](
+            self.params, tok, jnp.asarray(lens - 1), self.cache)
+        logits_np = np.asarray(logits)
+
+        out: list[list[int]] = [[] for _ in range(b)]
+        done = np.zeros(b, bool)
+        cur = np.zeros(b, np.int32)
+        for i in range(b):
+            cur[i] = sampler.sample(logits_np[i])
+            out[i].append(int(cur[i]))
+            if int(cur[i]) in stop_ids:
+                done[i] = True
+        pos = lens.copy()  # next write position per row
+        self.pos = int(pos.max())
+
+        def alive(i: int) -> bool:
+            # a row generates while unstopped, under budget, and with a free
+            # cache slot (pos < seq_len — generate()'s overflow guard, per row)
+            return (not done[i] and len(out[i]) < max_tokens
+                    and pos[i] < self.seq_len)
+
+        while any(alive(i) for i in range(b)):
+            tokv = jnp.asarray(cur[:, None])
+            # exhausted rows clamp their (ignored) write to the last slot so
+            # the scatter stays in bounds; their outputs stopped already
+            posv = jnp.asarray(np.minimum(pos, self.seq_len - 1))
+            if self._token_sharding is not None:
+                tokv = jax.device_put(tokv, self._token_sharding)
+                posv = jax.device_put(
+                    posv, NamedSharding(self.mesh, P(DP_AXIS)))
+            logits, self.cache = self._steps[vkey](
+                self.params, tokv, posv, self.cache)
+            logits_np = np.asarray(logits)
+            for i in range(b):
+                if not alive(i):
+                    continue
+                nxt = int(sampler.sample(logits_np[i]))
+                out[i].append(nxt)
+                cur[i] = nxt
+                if nxt in stop_ids:
+                    done[i] = True  # like generate(): stop token included,
+                    # then the row stops
+            pos = pos + 1
+            self.pos = int(np.minimum(pos, self.seq_len).max())
+        return out
+
     # -- on-device greedy decode loop (benchmark path) --------------------
 
     def decode_greedy_device(self, first_token: int, n_tokens: int) -> tuple[np.ndarray, float]:
@@ -282,6 +408,7 @@ class Engine:
                     compute_dtype=self.compute_dtype,
                     use_pallas=self.use_pallas,
                     tp_mesh=self._tp_mesh,
+                    sp_cache_mesh=self._sp_cache_mesh,
                 )
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (nxt[:, None], pos + 1, cache), nxt
