@@ -77,6 +77,28 @@ def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict
     }
 
 
+V5E_PEAK_BF16_TFLOPS = 197.0  # per chip; override with BENCH_PEAK_TFLOPS
+
+
+def _decode_read_bytes(spec: ModelSpec) -> int:
+    """HBM bytes one decode step must read: every layer weight + wcls in
+    packed Q40 form (0.5625 B/weight + f32 scales on device), one embedding
+    row, norms. The roofline denominator for effective-bandwidth."""
+    d, h, kv, v = spec.dim, spec.hidden_dim, spec.kv_dim, spec.vocab_size
+    per_layer_vals = d * d * 2 + kv * d * 2 + h * d * 2 + d * h
+    total_vals = per_layer_vals * spec.n_layers + v * d  # + wcls
+    packed = total_vals // 2               # device layout: 16 B per 32 nibbles
+    scales = total_vals // 32 * 4          # f32 block scales (separate array)
+    return packed + scales + d * 4 * (2 * spec.n_layers + 1) + d * 2
+
+
+def _decode_flops(spec: ModelSpec) -> int:
+    """MACs*2 per decoded token (matmul weights touched once each)."""
+    d, h, kv, v = spec.dim, spec.hidden_dim, spec.kv_dim, spec.vocab_size
+    per_layer = d * d * 2 + kv * d * 2 + h * d * 3
+    return 2 * (per_layer * spec.n_layers + v * d)
+
+
 def main() -> None:
     model = os.environ.get("BENCH_MODEL", "7b")
     n_tokens = int(os.environ.get("BENCH_TOKENS", "64"))
@@ -91,12 +113,22 @@ def main() -> None:
     _, dt = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
     ms_per_token = dt / n_tokens * 1e3
 
+    n_chips = 1
+    tok_s = 1000.0 / ms_per_token
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                       V5E_PEAK_BF16_TFLOPS))
+    eff_bw_gbs = _decode_read_bytes(spec) / (ms_per_token / 1e3) / 1e9
+    mfu = _decode_flops(spec) * tok_s / (peak_tflops * 1e12)
+
     print(json.dumps({
         "metric": f"llama2_7b_q40_decode_ms_per_token_1chip" if model == "7b"
                   else "tiny_llama_q40_decode_ms_per_token",
         "value": round(ms_per_token, 3),
         "unit": "ms/token",
         "vs_baseline": round(BASELINE_MS_PER_TOKEN / ms_per_token, 2),
+        "tokens_per_sec_per_chip": round(tok_s / n_chips, 2),
+        "effective_hbm_gbs": round(eff_bw_gbs, 1),
+        "mfu": round(mfu, 4),
     }))
 
 

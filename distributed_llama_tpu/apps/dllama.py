@@ -76,8 +76,8 @@ def build_engine(args):
     (ref: src/app.cpp:103-132)."""
     import jax.numpy as jnp
 
-    from ..io.model_file import read_model
-    from ..models.params import load_params
+    from ..io.model_file import read_spec
+    from ..models.loader import load_params_streamed
     from ..quants.types import FloatType
     from ..runtime.engine import Engine
     from ..sampler import Sampler
@@ -90,11 +90,10 @@ def build_engine(args):
     if args.weights_float_type:
         wft = FloatType[args.weights_float_type.upper()]
 
-    t0 = time.time()
-    spec, tensors = read_model(args.model, weights_float_type=wft)
-    print(f"⏩ loaded {args.model}: arch={spec.arch.name} dim={spec.dim} "
+    spec = read_spec(args.model, weights_float_type=wft)
+    print(f"⏩ {args.model}: arch={spec.arch.name} dim={spec.dim} "
           f"layers={spec.n_layers} heads={spec.n_heads}/{spec.n_kv_heads} "
-          f"seq={spec.seq_len} ({time.time()-t0:.1f}s)")
+          f"seq={spec.seq_len}")
 
     mode = "q40" if spec.weights_float_type == FloatType.Q40 else "dense"
     cdt = jnp.bfloat16 if args.compute_dtype == "bf16" else jnp.float32
@@ -105,7 +104,15 @@ def build_engine(args):
         from ..parallel.mesh import make_mesh
         mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp)
 
-    params = load_params(spec, tensors, mode=mode, dtype=cdt)
+    # streamed sharded load: one tensor resident at a time, each shard
+    # placed straight onto its device (ref weight push: transformer.cpp:562-621)
+    t0 = time.time()
+    params, lstats = load_params_streamed(
+        spec, args.model, mesh, mode=mode, dtype=cdt,
+        q80_collectives=(args.buffer_float_type == "q80"))
+    print(f"⏩ loaded {lstats.total_bytes / 1e9:.2f} GB in "
+          f"{time.time()-t0:.1f}s (peak host "
+          f"{lstats.peak_host_bytes / 1e6:.0f} MB)")
     engine = Engine(
         spec, params, mesh,
         batch=max(args.dp, 1),
@@ -169,16 +176,25 @@ def cmd_generate(args, benchmark: bool) -> None:
                           eos_id=tokenizer.stop_token_ids(), on_token=on_token)
     print()
     if benchmark:
-        # per-token G/I lines + averages (ref: dllama.cpp:47-48,74-91)
+        # per-token G/I/T/S lines + averages (ref: dllama.cpp:47-48,74-91);
+        # S = modeled per-device collective kB, T = measured all-reduce
+        # microbench scaled to the per-layer reduce count (netstats.py)
+        wire = engine.wire_estimate()
+        t_ms = engine.measure_transfer_ms()
         for i, s in enumerate(res.stats.steps):
             print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
-                  f"H {s.host_ms:5.2f} ms")
+                  f"T {t_ms:6.2f} ms H {s.host_ms:5.2f} ms "
+                  f"S {wire.sent_kb_per_token:7.1f} kB")
         avg = res.stats.averages()
         n = len(res.tokens)
         print(f"Generated tokens:    {n}")
         print(f"Avg tokens / second: {1000.0 / max(avg.generation_ms, 1e-9):.2f}")
         print(f"Avg generation time: {avg.generation_ms:.2f} ms")
         print(f"Avg inference time:  {avg.device_ms:.2f} ms")
+        print(f"Avg transfer (est):  {t_ms:.2f} ms, "
+              f"{wire.sent_kb_per_token:.1f} kB/token/device")
+        for kname, kb in wire.breakdown.items():
+            print(f"  {kname}: {kb:.1f} kB")
         print(f"Avg sampling time:   {avg.host_ms:.2f} ms")
 
 

@@ -68,8 +68,9 @@ class _ShardWalker:
     """One torch shard resident at a time, with a name->file index built
     lazily (ref: convert-grok-1.py:20-52)."""
 
-    def __init__(self, folder: str):
+    def __init__(self, folder: str, n_files: int = N_FILES):
         self.folder = folder
+        self.n_files = n_files
         self.index: dict[str, int] = {}
         self.current: dict | None = None
         self.current_idx = 0
@@ -82,7 +83,7 @@ class _ShardWalker:
         self.current = None
         gc.collect()
         path = os.path.join(
-            self.folder, f"pytorch_model-{idx:05d}-of-{N_FILES:05d}.bin")
+            self.folder, f"pytorch_model-{idx:05d}-of-{self.n_files:05d}.bin")
         print(f"💿 loading {os.path.basename(path)}", flush=True)
         self.current = torch.load(path, map_location="cpu")
         for k in self.current:
@@ -97,7 +98,7 @@ class _ShardWalker:
         while name not in self.current:
             if name in self.index:
                 self._load(self.index[name])
-            elif self.current_idx < N_FILES:
+            elif self.current_idx < self.n_files:
                 self._load(self.current_idx + 1)
             else:
                 raise KeyError(name)
@@ -105,9 +106,13 @@ class _ShardWalker:
 
 
 def convert_grok1(folder: str, out_path: str, weights_float_type: FloatType,
-                  progress: bool = True) -> ModelSpec:
-    spec = ModelSpec(weights_float_type=weights_float_type, **GROK1_SPEC)
-    walker = _ShardWalker(folder)
+                  progress: bool = True, spec: ModelSpec | None = None,
+                  n_files: int = N_FILES) -> ModelSpec:
+    """spec/n_files default to the production Grok-1 dump (ref:
+    convert-grok-1.py:59-70); overridable for shrunken test checkpoints."""
+    if spec is None:
+        spec = ModelSpec(weights_float_type=weights_float_type, **GROK1_SPEC)
+    walker = _ShardWalker(folder, n_files)
     with open(out_path, "wb") as f:
         write_header(f, spec)
         for name, shape, ftype in model_tensor_plan(spec):

@@ -209,21 +209,30 @@ def _read_tensor(f, name: str, shape: tuple[int, ...], ftype: FloatType) -> Host
     raise ValueError(ftype)
 
 
-def read_model(path: str, weights_float_type: FloatType | None = None,
-               spec: ModelSpec | None = None) -> tuple[ModelSpec, dict[str, HostTensor]]:
-    """Read header + all tensors. Streamed tensor-by-tensor to bound memory
-    (the reference streams from mmap, ref: src/transformer.cpp:607-621)."""
-    if spec is None:
-        spec = read_spec(path, weights_float_type)
-    header_size = getattr(spec, "_header_size")
-    tensors: dict[str, HostTensor] = {}
+def iter_model_tensors(path: str, spec: ModelSpec) -> Iterator[HostTensor]:
+    """Yield tensors one at a time in file order — the streaming read the
+    70B-scale loader consumes (models/loader.py): only one tensor's host
+    buffer is live per step (the reference streams from mmap the same way,
+    ref: src/transformer.cpp:607-621)."""
+    header_size = getattr(spec, "_header_size", None)
+    if header_size is None:  # spec built independently of this file
+        header_size = getattr(read_spec(path, spec.weights_float_type),
+                              "_header_size")
     with open(path, "rb") as f:
         f.seek(header_size)
         for name, shape, ftype in model_tensor_plan(spec):
-            tensors[name] = _read_tensor(f, name, shape, ftype)
-        rest = f.read(1)
-        if rest:
+            yield _read_tensor(f, name, shape, ftype)
+        if f.read(1):
             raise ValueError("model file has trailing bytes — spec/file mismatch")
+
+
+def read_model(path: str, weights_float_type: FloatType | None = None,
+               spec: ModelSpec | None = None) -> tuple[ModelSpec, dict[str, HostTensor]]:
+    """Read header + all tensors into one dict (small/medium models and
+    tests; the sharded streaming path is models/loader.py)."""
+    if spec is None:
+        spec = read_spec(path, weights_float_type)
+    tensors = {t.name: t for t in iter_model_tensors(path, spec)}
     return spec, tensors
 
 

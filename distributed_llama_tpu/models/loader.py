@@ -1,0 +1,244 @@
+"""Streamed sharded weight loading — the 70B path.
+
+The reference root streams the mmap'd file tensor-by-tensor, splitting each
+matrix and pushing every worker its shard over the socket while only the
+current tensor is resident (ref: src/transformer.cpp:562-621, 623-683). The
+TPU equivalent: iterate the file in plan order, convert each tensor to its
+device layout on the host, `jax.device_put` it with its NamedSharding (each
+device receives only its shard), and free the host buffer before the next
+tensor. Peak host memory is one fusion group (~3 tensors, or one layer's
+expert stack for MoE), never the whole model — `load_params_streamed`
+returns the measured peak so callers/tests can hold it to that bound.
+
+The result pytree is final: QKV/w1|w3 pre-fused when tp == 1, col weights
+pre-repacked to TpColWeight stacks when q80 collectives are on, every leaf
+already placed/sharded. Engine's own transforms detect and skip
+already-transformed params, so this feeds Engine(...) directly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..io.model_file import HostTensor, iter_model_tensors
+from ..quants.jax_codec import QuantizedTensor
+from ..quants.numpy_codec import quantize_q40
+from ..quants.types import FloatType
+from ..parallel.sharding import COL_SPLIT_NAMES, _SPLIT, _pspec_for
+from ..parallel.mesh import TP_AXIS
+from .spec import ArchType, ModelSpec
+
+
+class LoadStats(NamedTuple):
+    peak_host_bytes: int   # max bytes of file tensors resident at once
+    total_bytes: int       # total tensor bytes streamed
+
+
+def _host_bytes(t: HostTensor) -> int:
+    n = 0
+    for a in (t.data, t.scales, t.packed):
+        if a is not None:
+            n += a.nbytes
+    return n
+
+
+def _leaf_key(plan_name: str) -> str:
+    """'layers.3.wq' -> 'wq'; 'layers.0.experts.2.up' -> 'moe_up'."""
+    parts = plan_name.split(".")
+    if parts[0] != "layers":
+        return plan_name
+    if parts[2] == "experts":
+        return "moe_" + parts[4]
+    return parts[2]
+
+
+def _to_q40_host(x: np.ndarray) -> HostTensor:
+    scales, packed = quantize_q40(x.reshape(-1, x.shape[-1]))
+    return HostTensor("", FloatType.Q40, x.shape, scales=scales, packed=packed)
+
+
+class _Placer:
+    """Converts one host tensor (or fusion group) to device arrays with the
+    right NamedSharding, tracking q80-collective col repacking."""
+
+    def __init__(self, mesh, mode: str, dtype, tp: int, q80_collectives: bool):
+        self.mesh = mesh
+        self.mode = mode
+        self.dtype = dtype
+        self.tp = tp
+        self.q80 = q80_collectives and tp > 1
+
+    def _put(self, x: np.ndarray, pspec):
+        if self.mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, NamedSharding(self.mesh, pspec))
+
+    def dense(self, key: str, x: np.ndarray):
+        return self._put(x, _pspec_for(key, x.ndim, False, "dense"))
+
+    def weight(self, key: str, ts: list[HostTensor]):
+        """A matmul weight: single tensor, or an E-stacked expert list.
+        Applies mode (dense/q40), col repack for q80 collectives, sharding."""
+        stacked = len(ts) > 1
+        if self.mode != "q40":
+            x = np.stack([t.to_f32() for t in ts]) if stacked else ts[0].to_f32()
+            x = x.astype(np.dtype(self.dtype) if self.dtype != jnp.bfloat16
+                         else np.float32)
+            if self.q80 and key in COL_SPLIT_NAMES:
+                n = x.shape[-1]
+                xs = x.reshape(*x.shape[:-1], self.tp, n // self.tp)
+                xs = np.moveaxis(xs, -2, 0)
+                from ..parallel.tp_q80 import TpColWeight
+
+                ndim = xs.ndim
+                arr = self._put(np.ascontiguousarray(xs),
+                                _col_stack_pspec(ndim))
+                return TpColWeight(
+                    arr if self.dtype != jnp.bfloat16
+                    else arr.astype(jnp.bfloat16))
+            arr = self._put(x, _pspec_for(key, x.ndim, False, "dense"))
+            return arr.astype(self.dtype) if self.dtype == jnp.bfloat16 else arr
+
+        qs = [t if t.ftype == FloatType.Q40 else _to_q40_host(t.to_f32())
+              for t in ts]
+        packed = np.stack([q.packed for q in qs]) if stacked else qs[0].packed
+        scales = np.stack([q.scales for q in qs]) if stacked else qs[0].scales
+        if self.q80 and key in COL_SPLIT_NAMES:
+            return self._col_q40(packed, scales)
+        pk, sc = QuantizedTensor.host_layout(scales, packed)
+        return QuantizedTensor(
+            self._put(pk, _pspec_for(key, pk.ndim, True, "packed")),
+            self._put(sc, _pspec_for(key, sc.ndim, True, "scales")),
+        )
+
+    def _col_q40(self, packed: np.ndarray, scales: np.ndarray):
+        """Host-side block-aligned col repack -> TpColWeight stack, placed
+        shard-per-device (no transient full copy on one device — the repack
+        the engine-side path cannot avoid, parallel/sharding.py)."""
+        from ..parallel.tp_q80 import TpColWeight
+
+        tp = self.tp
+        nb = packed.shape[-2]
+        assert nb % tp == 0, (nb, tp)
+        lead = packed.shape[:-2]
+        pk = packed.reshape(*lead, tp, nb // tp, 16)
+        pk = np.moveaxis(pk, -3, 0)                      # (tp, ..., nb/tp, 16)
+        sc = np.moveaxis(scales.reshape(*lead, tp, nb // tp), -2, 0)
+        pk_dev, sc_dev = QuantizedTensor.host_layout(
+            np.ascontiguousarray(sc), np.ascontiguousarray(pk))
+        return TpColWeight(QuantizedTensor(
+            self._put(pk_dev, _col_stack_pspec(pk_dev.ndim)),
+            self._put(sc_dev, _col_stack_pspec(sc_dev.ndim)),
+        ))
+
+
+def _col_stack_pspec(ndim: int):
+    from jax.sharding import PartitionSpec as P
+
+    return P(TP_AXIS, *([None] * (ndim - 1)))
+
+
+def _fuse_group(key: str) -> str | None:
+    """Which single-shard fusion group a leaf belongs to (models/params.py:
+    fuse_layer_weights semantics, streamed)."""
+    if key in ("wq", "wk", "wv"):
+        return "wqkv"
+    if key in ("w1", "w3"):
+        return "w13"
+    return None
+
+
+def _concat_host(ts: list[HostTensor], mode: str) -> list[HostTensor]:
+    """Concatenate a fusion group along the output dim on the host."""
+    if mode == "q40":
+        qs = [t if t.ftype == FloatType.Q40 else _to_q40_host(t.to_f32())
+              for t in ts]
+        return [HostTensor("", FloatType.Q40,
+                           (sum(t.shape[0] for t in ts), ts[0].shape[1]),
+                           scales=np.concatenate([q.scales for q in qs]),
+                           packed=np.concatenate([q.packed for q in qs]))]
+    x = np.concatenate([t.to_f32() for t in ts], axis=0)
+    return [HostTensor("", FloatType.F32, x.shape, data=x)]
+
+
+def load_params_streamed(
+    spec: ModelSpec,
+    path: str,
+    mesh=None,
+    *,
+    mode: str = "q40",
+    dtype=jnp.bfloat16,
+    q80_collectives: bool = False,
+    fuse: bool | None = None,
+) -> tuple[dict, LoadStats]:
+    """Stream the `.m` file into a final, placed params pytree.
+
+    fuse defaults to tp == 1 (matching Engine's single-shard fast path).
+    Returns (params, LoadStats) — peak_host_bytes is the loader's measured
+    high-water mark of resident file-tensor bytes.
+    """
+    assert mode in ("dense", "q40")
+    tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+    if fuse is None:
+        fuse = tp == 1
+    placer = _Placer(mesh, mode, dtype, tp, q80_collectives)
+
+    p: dict = {"layers": [dict() for _ in range(spec.n_layers)]}
+    pending: dict[str, list[HostTensor]] = {}
+    peak = 0
+    total = 0
+    live = 0
+
+    def target(plan_name: str):
+        parts = plan_name.split(".")
+        if parts[0] == "layers":
+            return p["layers"][int(parts[1])]
+        return p
+
+    for t in iter_model_tensors(path, spec):
+        b = _host_bytes(t)
+        total += b
+        live += b
+        key = _leaf_key(t.name)
+        dest = target(t.name)
+        group = _fuse_group(key) if fuse else None
+
+        if group is not None:
+            pending.setdefault(f"{t.name.rsplit('.', 1)[0]}.{group}", []).append(t)
+            peak = max(peak, live)
+            gk = f"{t.name.rsplit('.', 1)[0]}.{group}"
+            want = 3 if group == "wqkv" else 2
+            if len(pending[gk]) == want:
+                ts = pending.pop(gk)
+                dest[group] = placer.weight(group, _concat_host(ts, mode))
+                live -= sum(_host_bytes(x) for x in ts)
+            continue
+
+        if key.startswith("moe_") and key != "moe_router":
+            # experts stream in (up, gate, down) x E order; stack per role
+            pending.setdefault(f"{t.name.rsplit('.', 2)[0]}.{key}", []).append(t)
+            peak = max(peak, live)
+            gk = f"{t.name.rsplit('.', 2)[0]}.{key}"
+            if len(pending[gk]) == spec.n_experts:
+                ts = pending.pop(gk)
+                dest[key] = placer.weight(key, ts)
+                live -= sum(_host_bytes(x) for x in ts)
+            continue
+
+        peak = max(peak, live)
+        if key in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2", "rms_final"):
+            dest[key] = placer.dense(key, t.to_f32())  # norms stay f32
+        elif key in ("tok_emb", "moe_router"):
+            arr = placer.dense(key, t.to_f32())
+            dest[key] = arr.astype(dtype) if dtype != jnp.float32 else arr
+        else:
+            dest[key] = placer.weight(key, [t])
+        live -= b
+
+    assert not pending, f"incomplete fusion groups: {list(pending)}"
+    return p, LoadStats(peak_host_bytes=peak, total_bytes=total)

@@ -139,12 +139,16 @@ def repack_col_weights(params: dict, tp: int) -> dict:
     weight on the default device before shard_params distributes it; the
     streamed loader (models/loader.py) repacks host-side per tensor and
     places shards directly, avoiding the spike — prefer it at 70B scale."""
-    from .tp_q80 import repack_col_tp
+    from .tp_q80 import TpColWeight, repack_col_tp
+
+    def repack(v):
+        if isinstance(v, TpColWeight):  # already repacked (streamed loader)
+            return v
+        return repack_col_tp(v, tp)
 
     out = dict(params)
     out["layers"] = [
-        {k: (repack_col_tp(v, tp) if k in COL_SPLIT_NAMES else v)
-         for k, v in lw.items()}
+        {k: (repack(v) if k in COL_SPLIT_NAMES else v) for k, v in lw.items()}
         for lw in params["layers"]
     ]
     return out

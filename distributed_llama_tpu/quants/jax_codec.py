@@ -71,14 +71,23 @@ class QuantizedTensor:
             jnp.take(self.scales, indices, axis=axis),
         )
 
+    @staticmethod
+    def host_layout(scales: np.ndarray, packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Host block-major packed (..., nb, 16) -> the device layout as
+        numpy: (flattened (..., 16*nb) u8, f32 scales). Split out from
+        from_numpy so a sharded loader can jax.device_put the arrays with an
+        explicit NamedSharding instead of the default device."""
+        nb = packed.shape[-2]
+        swapped = np.ascontiguousarray(packed.swapaxes(-1, -2))
+        return (swapped.reshape(*swapped.shape[:-2], 16 * nb),
+                scales.astype(np.float32))
+
     @classmethod
     def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
         """Host block-major packed (..., nb, 16) -> device flattened (..., 16*nb);
         f16 file scales widen to f32 (see class docstring)."""
-        nb = packed.shape[-2]
-        swapped = np.ascontiguousarray(packed.swapaxes(-1, -2))
-        return cls(jnp.asarray(swapped.reshape(*swapped.shape[:-2], 16 * nb)),
-                   jnp.asarray(scales.astype(np.float32)))
+        pk, sc = cls.host_layout(scales, packed)
+        return cls(jnp.asarray(pk), jnp.asarray(sc))
 
 
 def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:

@@ -145,26 +145,68 @@ class Engine:
         self.cache = self._new_cache()
         self.pos = 0
 
+    # -- observability -----------------------------------------------------
+
+    def wire_estimate(self):
+        """Modeled per-token per-device collective bytes for this engine's
+        mesh/config (the reference's S/R columns, ref: socket.cpp:266-271)."""
+        from .netstats import estimate_decode_wire
+
+        return estimate_decode_wire(
+            self.spec, self.mesh,
+            q80=self.q80_collectives,
+            act_bytes=jnp.dtype(self.compute_dtype).itemsize,
+            batch=self.batch)
+
+    def measure_transfer_ms(self) -> float:
+        """Measured per-token transfer estimate: times one dim-sized
+        all-reduce on the mesh and scales by the per-layer reduce count (the
+        reference's T column, measured not modeled)."""
+        from .netstats import measure_allreduce_ms
+
+        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+            return 0.0
+        per = measure_allreduce_ms(self.mesh, self.spec.dim)
+        reduces = (1 + self.spec.n_active_experts) if self.spec.is_moe else 2
+        return per * reduces * self.spec.n_layers
+
     # -- compiled steps ---------------------------------------------------
 
-    def _step_fn(self, t: int) -> Callable:
-        """Compiled forward for a T-token segment (cached per T)."""
-        if t in self._steps:
-            return self._steps[t]
+    def _compiled_step(self, key, *, sp_mesh=None,
+                       with_logit_index: bool = False) -> Callable:
+        """One cached jitted forward wrapper for every execution path.
 
-        def run(params, tokens, pos0, cache):
-            return forward(
-                params, self.spec, tokens, pos0, cache,
-                activation_q80=self.activation_q80,
-                compute_dtype=self.compute_dtype,
-                use_pallas=self.use_pallas,
-                tp_mesh=self._tp_mesh,
-                sp_cache_mesh=self._sp_cache_mesh,
-            )
+        Two shapes share it: (params, tokens, pos, cache) with pos scalar
+        (step) or (B,) vector (batched decode), and
+        (params, tokens, logit_index, cache) for whole-segment prefill from
+        pos 0 (right-padded batch; ring when sp_mesh is set). Single builder
+        so a new forward() knob is threaded exactly once."""
+        if key in self._steps:
+            return self._steps[key]
+
+        common = dict(
+            activation_q80=self.activation_q80,
+            compute_dtype=self.compute_dtype,
+            use_pallas=self.use_pallas,
+            tp_mesh=self._tp_mesh,
+            sp_cache_mesh=self._sp_cache_mesh,
+        )
+        if with_logit_index:
+            def run(params, tokens, logit_index, cache):
+                return forward(params, self.spec, tokens, jnp.int32(0), cache,
+                               sp_mesh=sp_mesh, logit_index=logit_index,
+                               **common)
+        else:
+            def run(params, tokens, pos0, cache):
+                return forward(params, self.spec, tokens, pos0, cache,
+                               **common)
 
         fn = jax.jit(run, donate_argnums=(3,))
-        self._steps[t] = fn
+        self._steps[key] = fn
         return fn
+
+    def _step_fn(self, t: int) -> Callable:
+        return self._compiled_step(t)
 
     def step(self, tokens: np.ndarray, pos0: int) -> jax.Array:
         """Run a (B, T) segment from absolute position pos0; returns last-token
@@ -213,27 +255,13 @@ class Engine:
         t = n + pad
         assert t <= self.seq_len, "context overflow"  # caller checked padding fits
 
-        key = ("ring", t)
-        if key not in self._steps:
-            def run(params, tokens, logit_index, cache):
-                return forward(
-                    params, self.spec, tokens, jnp.int32(0), cache,
-                    activation_q80=self.activation_q80,
-                    compute_dtype=self.compute_dtype,
-                    use_pallas=self.use_pallas,
-                    sp_mesh=self.mesh,
-                    tp_mesh=self._tp_mesh,
-                    sp_cache_mesh=self._sp_cache_mesh,
-                    logit_index=logit_index,
-                )
-            self._steps[key] = jax.jit(run, donate_argnums=(3,))
-
+        fn = self._compiled_step(("ring", t), sp_mesh=self.mesh,
+                                 with_logit_index=True)
         seg = np.zeros((1, t), np.int32)
         seg[0, :n] = prompt
         tok = jax.device_put(jnp.asarray(seg),
                              NamedSharding(self.mesh, P(DP_AXIS, SP_AXIS)))
-        logits, self.cache = self._steps[key](
-            self.params, tok, jnp.int32(n - 1), self.cache)
+        logits, self.cache = fn(self.params, tok, jnp.int32(n - 1), self.cache)
         self.pos = n
         return logits
 
@@ -310,32 +338,8 @@ class Engine:
         # real token. Padded slots write garbage K/V at positions >= len(p),
         # but those cache slots are overwritten by decode before any query
         # position can attend to them (attention masks k_pos <= q_pos).
-        key = ("bpre", t)
-        if key not in self._steps:
-            def run_pre(params, tokens, logit_index, cache):
-                return forward(
-                    params, self.spec, tokens, jnp.int32(0), cache,
-                    activation_q80=self.activation_q80,
-                    compute_dtype=self.compute_dtype,
-                    use_pallas=self.use_pallas,
-                    tp_mesh=self._tp_mesh,
-                    sp_cache_mesh=self._sp_cache_mesh,
-                    logit_index=logit_index,
-                )
-            self._steps[key] = jax.jit(run_pre, donate_argnums=(3,))
-
-        vkey = ("bvec", 1)
-        if vkey not in self._steps:
-            def run_vec(params, tokens, pos_vec, cache):
-                return forward(
-                    params, self.spec, tokens, pos_vec, cache,
-                    activation_q80=self.activation_q80,
-                    compute_dtype=self.compute_dtype,
-                    use_pallas=self.use_pallas,
-                    tp_mesh=self._tp_mesh,
-                    sp_cache_mesh=self._sp_cache_mesh,
-                )
-            self._steps[vkey] = jax.jit(run_vec, donate_argnums=(3,))
+        pre_fn = self._compiled_step(("bpre", t), with_logit_index=True)
+        vec_fn = self._compiled_step(("bvec", 1))
 
         padded = np.zeros((b, t), np.int32)
         for i, p in enumerate(prompts):
@@ -343,7 +347,7 @@ class Engine:
         tok = jnp.asarray(padded)
         if self._token_sharding is not None:
             tok = jax.device_put(tok, self._token_sharding)
-        logits, self.cache = self._steps[key](
+        logits, self.cache = pre_fn(
             self.params, tok, jnp.asarray(lens - 1), self.cache)
         logits_np = np.asarray(logits)
 
@@ -373,7 +377,7 @@ class Engine:
                 tokv = jax.device_put(tokv, self._token_sharding)
                 posv = jax.device_put(
                     posv, NamedSharding(self.mesh, P(DP_AXIS)))
-            logits, self.cache = self._steps[vkey](
+            logits, self.cache = vec_fn(
                 self.params, tokv, posv, self.cache)
             logits_np = np.asarray(logits)
             for i in range(b):

@@ -1,0 +1,119 @@
+"""Wire/collective observability.
+
+The reference surfaces per-token transfer time and sent/received kB from its
+socket byte counters (ref: src/socket.cpp:266-271, printed as the T/S/R
+columns in benchmark mode — src/apps/dllama/dllama.cpp:74-91). Under XLA the
+collectives live inside one compiled program, so the equivalent here is:
+
+  * `estimate_decode_wire` — exact modeled bytes per decoded token per
+    device, derived from the mesh and the sharding design (which collectives
+    GSPMD/shard_map emit is determined by the partition specs, so the byte
+    count is computable, not guessed);
+  * `measure_allreduce_ms` — a timed collective microbench on the real mesh,
+    giving the per-token transfer-time estimate the reference measures
+    directly.
+
+Ring-algorithm cost model: an all-reduce moves 2*(n-1)/n * payload per
+device, an all-gather / all-to-all (n-1)/n * payload (SURVEY.md §3.4 maps
+the reference's per-layer broadcast/gather pairs onto these).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from ..models.spec import ModelSpec
+
+
+class WireEstimate(NamedTuple):
+    sent_kb_per_token: float          # per device, per decoded token
+    breakdown: dict                   # component -> kB
+
+
+def _ar(n: int, payload: float) -> float:
+    """Ring all-reduce bytes sent per device."""
+    return 2 * (n - 1) / n * payload
+
+
+def _ag(n: int, payload: float) -> float:
+    """Ring all-gather (or all-to-all) bytes sent per device; `payload` is
+    the full gathered size."""
+    return (n - 1) / n * payload
+
+
+def estimate_decode_wire(
+    spec: ModelSpec,
+    mesh,
+    *,
+    q80: bool = False,
+    act_bytes: int = 4,
+    batch: int = 1,
+) -> WireEstimate:
+    """Modeled bytes each device sends per decoded token.
+
+    tp: 2 partial-sum all-reduces per dense layer (wo, w2 — the reference's
+    2 broadcast + 2 gather pairs collapse to these, SURVEY.md §3.4), one per
+    active expert + one for wo on MoE layers, plus the vocab-sharded logits
+    all-gather. q80 mode swaps the f32 all-reduce for the two-shot quantized
+    exchange (int8 + f16 block scales = 1.0625 B/value).
+    sp: the decode-attention stat merge (acc + m + l per layer).
+    dp: no inter-device traffic at inference.
+    """
+    if mesh is None:
+        return WireEstimate(0.0, {})
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    dp = mesh.shape.get("dp", 1)
+    b_local = max(1, batch // dp)
+    bd: dict[str, float] = {}
+
+    if tp > 1:
+        reduces_per_layer = (1 + spec.n_active_experts) if spec.is_moe else 2
+        val_bytes = 1.0625 if q80 else act_bytes  # int8 + f16/32-block scale
+        per_reduce = spec.dim * b_local * val_bytes
+        layer_fn = _ar  # both the f32 all-reduce and the 2-shot q80
+        # exchange move 2*(n-1)/n * payload per device
+        bd["tp_partial_sums"] = (spec.n_layers * reduces_per_layer
+                                 * layer_fn(tp, per_reduce))
+        bd["tp_logits_gather"] = _ag(tp, spec.vocab_size * b_local * 4)
+    if sp > 1:
+        stat = spec.n_heads * spec.head_size + 2 * spec.n_heads  # acc + m + l
+        bd["sp_attn_merge"] = spec.n_layers * _ar(sp, stat * b_local * 4)
+
+    total = sum(bd.values())
+    return WireEstimate(total / 1024.0,
+                        {k: v / 1024.0 for k, v in bd.items()})
+
+
+def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16) -> float:
+    """Time one f32 all-reduce of `payload_elems` on the mesh's tp axis —
+    the measured analogue of the reference's per-token T column. Returns ms
+    per all-reduce (amortized over iters; sync via device->host transfer,
+    the only true sync on tunneled TPU platforms)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("tp", 1)
+    if tp <= 1:
+        return 0.0
+
+    @jax.jit
+    def run(x):
+        def body(v):
+            for _ in range(iters):
+                v = jax.lax.psum(v, "tp") * (1.0 / tp)
+            return v
+        return shard_map(body, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+                         check_vma=False)(x)
+
+    x = jnp.ones((tp, payload_elems), jnp.float32)
+    np.asarray(run(x))  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(run(x))
+    dt = time.perf_counter() - t0
+    return dt / iters * 1e3

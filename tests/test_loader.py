@@ -1,0 +1,124 @@
+"""Streamed sharded weight loading (models/loader.py — VERDICT r1 #5).
+
+The reference root streams the mmap'd file and pushes shards as it walks
+(ref: src/transformer.cpp:562-621); here each tensor must go host -> sharded
+device placement one at a time, with peak host memory bounded by a fusion
+group, never the model size.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.model_file import write_model
+from distributed_llama_tpu.models import ArchType, ModelSpec
+from distributed_llama_tpu.models.loader import load_params_streamed
+from distributed_llama_tpu.models.params import load_params
+from distributed_llama_tpu.models.transformer import KVCache, forward
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.parallel.mesh import TP_AXIS
+from distributed_llama_tpu.quants.types import FloatType
+from distributed_llama_tpu.runtime import Engine
+
+from test_model_forward import make_spec, dense_weights
+
+
+def _write_file(tmp_path, spec, seed=3):
+    host, _ = dense_weights(spec, seed=seed)
+    dense = {name: t.to_f32() for name, t in host.items()}
+    path = str(tmp_path / "model.m")
+    write_model(path, spec, dense)
+    return path, host
+
+
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
+@pytest.mark.parametrize("mode", ["dense", "q40"])
+def test_streamed_matches_bulk_load(tmp_path, arch, mode):
+    """Streamed single-device load produces the same logits as the bulk
+    read_model + load_params path (incl. the fused wqkv/w13 layout)."""
+    spec = make_spec(arch, dim=64, n_heads=8, n_kv_heads=4,
+                     weights_float_type=FloatType.Q40 if mode == "q40"
+                     else FloatType.F32)
+    path, host = _write_file(tmp_path, spec)
+
+    params, stats = load_params_streamed(spec, path, mode=mode,
+                                         dtype=jnp.float32)
+    assert stats.total_bytes > 0
+    # streamed default fuses like the engine's tp==1 fast path
+    assert "wqkv" in params["layers"][0]
+
+    ref_params = load_params(spec, host, mode=mode, dtype=jnp.float32)
+    tok = jnp.array([[7]], jnp.int32)
+    want, _ = forward(ref_params, spec, tok, jnp.int32(0), KVCache.create(spec, 1))
+    got, _ = forward(params, spec, tok, jnp.int32(0), KVCache.create(spec, 1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=2e-4)
+
+
+def test_streamed_peak_host_memory_bounded(tmp_path):
+    """Peak resident file-tensor bytes stay one fusion-group-sized — far
+    below the whole file (the 70B guarantee, measured not assumed)."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     n_layers=8, weights_float_type=FloatType.Q40)
+    path, _ = _write_file(tmp_path, spec)
+
+    _, stats = load_params_streamed(spec, path, mode="q40")
+    # the biggest resident set is the w1|w3 fusion pair plus the tensor in
+    # flight; the whole file is ~8 layers of everything
+    assert stats.peak_host_bytes < stats.total_bytes / 4
+    per_layer = stats.total_bytes / spec.n_layers
+    assert stats.peak_host_bytes < per_layer * 1.5
+
+
+def test_streamed_sharded_placement(tmp_path):
+    """With a tp mesh each weight lands pre-sharded (row/col split), and an
+    Engine built from the streamed pytree matches the bulk-path engine."""
+    spec = make_spec(ArchType.LLAMA, dim=128, n_heads=8, n_kv_heads=4,
+                     hidden_dim=256, weights_float_type=FloatType.Q40)
+    path, host = _write_file(tmp_path, spec)
+    mesh = make_mesh(tp=4)
+
+    params, _ = load_params_streamed(spec, path, mesh, mode="q40",
+                                     dtype=jnp.float32)
+    lw = params["layers"][0]
+    assert lw["wq"].packed.sharding.spec[0] == TP_AXIS      # row split
+    assert lw["wo"].packed.sharding.spec[-1] == TP_AXIS     # col split
+    assert "wqkv" not in lw                                  # no fuse under tp
+
+    eng = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    ref_params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    ref = Engine(spec, ref_params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    a = np.asarray(eng.step(np.array([[7]], np.int32), 0))
+    b = np.asarray(ref.step(np.array([[7]], np.int32), 0))
+    np.testing.assert_allclose(a, b, rtol=0, atol=2e-4)
+
+
+def test_streamed_q80_collective_layout(tmp_path):
+    """q80_collectives=True pre-repacks col weights into TpColWeight stacks
+    host-side; the engine detects and keeps them, and the q80 forward runs."""
+    from distributed_llama_tpu.parallel.tp_q80 import TpColWeight
+
+    spec = make_spec(ArchType.LLAMA, dim=128, n_heads=8, n_kv_heads=4,
+                     hidden_dim=256, weights_float_type=FloatType.Q40)
+    path, host = _write_file(tmp_path, spec)
+    mesh = make_mesh(tp=4)
+
+    params, _ = load_params_streamed(spec, path, mesh, mode="q40",
+                                     dtype=jnp.float32, q80_collectives=True)
+    lw = params["layers"][0]
+    assert isinstance(lw["wo"], TpColWeight)
+    assert lw["wo"].w.packed.sharding.spec[0] == TP_AXIS    # stack axis on tp
+
+    eng = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, q80_collectives=True)
+    assert isinstance(eng.params["layers"][0]["wo"], TpColWeight)
+
+    # numerics: matches the engine-side repack route within quant tolerance
+    ref_params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    ref = Engine(spec, ref_params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, q80_collectives=True)
+    a = np.asarray(eng.step(np.array([[7]], np.int32), 0))
+    b = np.asarray(ref.step(np.array([[7]], np.int32), 0))
+    np.testing.assert_allclose(a, b, rtol=0, atol=1e-4)

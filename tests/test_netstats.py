@@ -1,0 +1,71 @@
+"""Collective-bytes observability (runtime/netstats.py — VERDICT r1 #7).
+
+Checks the modeled wire bytes against the reference's published per-token
+transfer table (ref README.md:96-110: Llama 3 8B, F32 2048 kB vs Q80 544 kB
+at 2 devices — the ~4x quantized-wire claim)."""
+
+import numpy as np
+
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.runtime.netstats import (
+    estimate_decode_wire,
+    measure_allreduce_ms,
+)
+
+LLAMA3_8B = ModelSpec(
+    arch=ArchType.LLAMA, dim=4096, hidden_dim=14336, n_layers=32,
+    n_heads=32, n_kv_heads=8, vocab_size=128256, seq_len=8192,
+    hidden_act=HiddenAct.SILU)
+
+
+def test_wire_estimate_q80_ratio_matches_reference_claim():
+    """q80 vs f32 bytes ratio reproduces the reference's ~3.8x wire cut
+    (2048 kB -> 544 kB, ref README.md:98-108) on the per-layer reductions."""
+    mesh = make_mesh(tp=2)
+    f32 = estimate_decode_wire(LLAMA3_8B, mesh, q80=False)
+    q80 = estimate_decode_wire(LLAMA3_8B, mesh, q80=True)
+    ratio = f32.breakdown["tp_partial_sums"] / q80.breakdown["tp_partial_sums"]
+    assert abs(ratio - 4 / 1.0625) < 0.01  # 3.7647x
+
+    # magnitude sanity vs the reference's 2-device table: same order as its
+    # 2048 kB (f32) / 544 kB (q80); our all-reduce design halves the star
+    # topology's 2 broadcasts + 2 gathers, so expect roughly half
+    assert 512 <= f32.sent_kb_per_token <= 2048
+    assert 136 <= q80.sent_kb_per_token <= 700
+
+
+def test_wire_estimate_components():
+    mesh = make_mesh(tp=4, sp=2)
+    est = estimate_decode_wire(LLAMA3_8B, mesh, q80=False)
+    assert set(est.breakdown) == {"tp_partial_sums", "tp_logits_gather",
+                                  "sp_attn_merge"}
+    assert est.sent_kb_per_token > 0
+    # single-device: nothing moves
+    assert estimate_decode_wire(LLAMA3_8B, None).sent_kb_per_token == 0
+    assert estimate_decode_wire(
+        LLAMA3_8B, make_mesh(tp=1, dp=8)).sent_kb_per_token == 0
+
+
+def test_measured_allreduce_runs():
+    mesh = make_mesh(tp=4)
+    ms = measure_allreduce_ms(mesh, 4096, iters=4)
+    assert ms > 0
+    assert measure_allreduce_ms(make_mesh(tp=1, dp=8), 4096) == 0.0
+
+
+def test_engine_wire_surface():
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.params import load_params, random_tensors
+    from distributed_llama_tpu.runtime import Engine
+    from test_model_forward import make_spec, dense_weights
+
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=2)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    eng = Engine(spec, params, make_mesh(tp=2), compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    est = eng.wire_estimate()
+    assert est.sent_kb_per_token > 0
+    assert eng.measure_transfer_ms() > 0
