@@ -29,7 +29,7 @@ from ..io.model_file import HostTensor, iter_model_tensors
 from ..quants.jax_codec import QuantizedTensor
 from ..quants.numpy_codec import quantize_q40
 from ..quants.types import FloatType
-from ..parallel.sharding import COL_SPLIT_NAMES, _SPLIT, _pspec_for
+from ..parallel.sharding import COL_SPLIT_NAMES, _pspec_for
 from ..parallel.mesh import TP_AXIS
 from .spec import ArchType, ModelSpec
 
@@ -204,14 +204,14 @@ def load_params_streamed(
         b = _host_bytes(t)
         total += b
         live += b
+        peak = max(peak, live)
         key = _leaf_key(t.name)
         dest = target(t.name)
         group = _fuse_group(key) if fuse else None
 
         if group is not None:
-            pending.setdefault(f"{t.name.rsplit('.', 1)[0]}.{group}", []).append(t)
-            peak = max(peak, live)
             gk = f"{t.name.rsplit('.', 1)[0]}.{group}"
+            pending.setdefault(gk, []).append(t)
             want = 3 if group == "wqkv" else 2
             if len(pending[gk]) == want:
                 ts = pending.pop(gk)
@@ -221,16 +221,14 @@ def load_params_streamed(
 
         if key.startswith("moe_") and key != "moe_router":
             # experts stream in (up, gate, down) x E order; stack per role
-            pending.setdefault(f"{t.name.rsplit('.', 2)[0]}.{key}", []).append(t)
-            peak = max(peak, live)
             gk = f"{t.name.rsplit('.', 2)[0]}.{key}"
+            pending.setdefault(gk, []).append(t)
             if len(pending[gk]) == spec.n_experts:
                 ts = pending.pop(gk)
                 dest[key] = placer.weight(key, ts)
                 live -= sum(_host_bytes(x) for x in ts)
             continue
 
-        peak = max(peak, live)
         if key in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2", "rms_final"):
             dest[key] = placer.dense(key, t.to_f32())  # norms stay f32
         elif key in ("tok_emb", "moe_router"):

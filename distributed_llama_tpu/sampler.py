@@ -9,6 +9,8 @@ matches the reference qsort comparator's ordering of distinct values
 
 An on-device (jnp) greedy path is provided separately in the engine for
 latency; this host sampler is the full-featured reference-parity path.
+A C++ twin (native/dllama_native.cpp, parity-tested in tests/test_native.py)
+is used automatically when built — backend="python" forces this oracle.
 """
 
 from __future__ import annotations
@@ -19,23 +21,51 @@ from .utils.rng import xorshift_f32
 
 
 class Sampler:
-    def __init__(self, vocab_size: int, temperature: float, topp: float, seed: int):
+    def __init__(self, vocab_size: int, temperature: float, topp: float,
+                 seed: int, backend: str = "auto"):
         self.vocab_size = vocab_size
         self.temperature = float(temperature)
         self.topp = float(topp)
-        self.rng_state = seed & ((1 << 64) - 1)
+        self._native = None
+        if backend in ("auto", "native"):
+            from . import native
+
+            if native.available():
+                self._native = native.NativeSampler(
+                    vocab_size, temperature, topp, seed)
+            elif backend == "native":
+                raise RuntimeError("native backend requested but "
+                                   "libdllama_native.so is not built")
+        self._rng_state = seed & ((1 << 64) - 1)
+
+    @property
+    def rng_state(self) -> int:
+        if self._native is not None:
+            return self._native.rng_state
+        return self._rng_state
+
+    @rng_state.setter
+    def rng_state(self, v: int) -> None:
+        if self._native is not None:
+            self._native.rng_state = v
+        else:
+            self._rng_state = v & ((1 << 64) - 1)
 
     def set_temp(self, temperature: float) -> None:
         self.temperature = float(temperature)
+        if self._native is not None:
+            self._native.set_temp(temperature)
 
     def set_seed(self, seed: int) -> None:
         self.rng_state = seed & ((1 << 64) - 1)
 
     def _coin(self) -> float:
-        self.rng_state, v = xorshift_f32(self.rng_state)
+        self._rng_state, v = xorshift_f32(self._rng_state)
         return v
 
     def sample(self, logits: np.ndarray) -> int:
+        if self._native is not None:
+            return self._native.sample(logits)
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
         if self.temperature == 0.0:
             return int(np.argmax(logits))

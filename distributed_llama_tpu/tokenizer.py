@@ -5,9 +5,11 @@ UTF-8 codepoint scan, byte-fallback at +3 offset, then greedy highest-score
 pair merging. Decode strips a leading space after BOS and expands `<0xXX>`
 raw-byte pieces (ref: src/tokenizer.cpp:89-100).
 
-A C++ implementation with the same behavior lives in native/ (used when the
-compiled extension is available); this pure-Python version is the fallback
-and the correctness oracle.
+A C++ implementation with the same behavior lives in native/
+(dllama_native.cpp, built with `make -C native`) and is used automatically
+when the shared library is present (backend="auto"); this pure-Python
+version is the fallback and the correctness oracle the native code is
+parity-tested against (tests/test_native.py).
 """
 
 from __future__ import annotations
@@ -16,7 +18,7 @@ from .io.tokenizer_file import TokenizerData, read_tokenizer_file
 
 
 class Tokenizer:
-    def __init__(self, data: TokenizerData):
+    def __init__(self, data: TokenizerData, backend: str = "auto"):
         self.data = data
         self.vocab = data.vocab
         self.scores = data.scores
@@ -27,6 +29,16 @@ class Tokenizer:
             # first occurrence wins, like bsearch over a stable-sorted vocab
             if tok not in self._index:
                 self._index[tok] = i
+        self._native = None
+        if backend in ("auto", "native"):
+            from . import native
+
+            if native.available():
+                self._native = native.NativeTokenizer(
+                    self.vocab, self.scores, self.bos_id, self.eos_id)
+            elif backend == "native":
+                raise RuntimeError("native backend requested but "
+                                   "libdllama_native.so is not built")
 
     @classmethod
     def from_file(cls, path: str) -> "Tokenizer":
@@ -52,6 +64,8 @@ class Tokenizer:
         return ids
 
     def encode(self, text: str, add_bos: bool = True, add_eos: bool = False) -> list[int]:
+        if self._native is not None:
+            return self._native.encode(text, add_bos, add_eos)
         tokens: list[int] = []
         if add_bos:
             tokens.append(self.bos_id)
@@ -102,6 +116,8 @@ class Tokenizer:
         return tokens
 
     def decode_piece(self, prev_token: int, token: int) -> bytes:
+        if self._native is not None:
+            return self._native.decode_piece(prev_token, token)
         piece = self.vocab[token]
         if prev_token == self.bos_id and piece.startswith(b" "):
             piece = piece[1:]

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Fixed-seed end-to-end determinism check — the reference's examples/
+# macbeth.sh (fixed seed/temp/topp, transcript comparison), using the
+# pinned-token-sequence test fixture instead of a 4 GB model download.
+# Exits nonzero if the generated sequence diverges from the stored golden.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_determinism.py -q

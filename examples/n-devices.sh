@@ -1,0 +1,15 @@
+#!/bin/sh
+# Multi-device demo on N virtual CPU devices — the analogue of the
+# reference's examples/n-workers.sh (which screen-launches N worker
+# processes); here the "cluster" is one SPMD program over an N-device mesh.
+#
+# Usage: N=8 ./examples/n-devices.sh
+set -e
+cd "$(dirname "$0")/.."
+N="${N:-8}"
+JAX_PLATFORMS=cpu python - <<EOF
+import __graft_entry__ as g
+g.dryrun_multichip($N)
+print("✅ dp x tp batched generation, sp ring prefill + sp-cache decode,")
+print("   and q80-collective TP all ran on a $N-device mesh")
+EOF

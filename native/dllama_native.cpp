@@ -1,0 +1,237 @@
+// Native host-side components: BPE tokenizer + sampler.
+//
+// C++ twins of the Python implementations in distributed_llama_tpu/
+// tokenizer.py and sampler.py, behavior-equivalent to the reference's
+// tokenizer/sampler (ref: src/tokenizer.cpp:109-229 encode, 89-100 decode,
+// 231-364 sampler; RNG ref: src/utils.cpp:53-64). Exposed as a C ABI
+// consumed via ctypes (distributed_llama_tpu/native.py); the Python
+// versions remain the correctness oracle and fallback.
+//
+// Build: make -C native   (produces libdllama_native.so)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- tokenizer
+
+struct Tokenizer {
+    std::vector<std::string> vocab;
+    std::vector<float> scores;
+    std::unordered_map<std::string, int32_t> index;  // first occurrence wins
+    int32_t bos_id;
+    int32_t eos_id;
+};
+
+void* dllama_tok_create(int32_t n, const uint8_t* pieces,
+                        const int32_t* piece_lens, const float* scores,
+                        int32_t bos_id, int32_t eos_id) {
+    Tokenizer* t = new Tokenizer();
+    t->bos_id = bos_id;
+    t->eos_id = eos_id;
+    t->vocab.reserve(n);
+    t->scores.assign(scores, scores + n);
+    size_t off = 0;
+    for (int32_t i = 0; i < n; i++) {
+        t->vocab.emplace_back(reinterpret_cast<const char*>(pieces) + off,
+                              (size_t)piece_lens[i]);
+        off += piece_lens[i];
+        t->index.emplace(t->vocab.back(), i);  // emplace keeps the first id
+    }
+    return t;
+}
+
+void dllama_tok_free(void* h) { delete static_cast<Tokenizer*>(h); }
+
+static int32_t lookup(const Tokenizer* t, const std::string& s) {
+    auto it = t->index.find(s);
+    return it == t->index.end() ? -1 : it->second;
+}
+
+// Encode `text` (UTF-8, text_len bytes) into out[max_out]; returns the token
+// count, or -1 if out is too small. Mirrors tokenizer.py:encode.
+int32_t dllama_tok_encode(void* h, const uint8_t* text, int32_t text_len,
+                          int32_t add_bos, int32_t add_eos,
+                          int32_t* out, int32_t max_out) {
+    const Tokenizer* t = static_cast<Tokenizer*>(h);
+    std::vector<int32_t> toks;
+    if (add_bos) toks.push_back(t->bos_id);
+    if (text_len > 0) {
+        // dummy space prefix (ref: src/tokenizer.cpp:140-144)
+        int32_t space = lookup(t, " ");
+        if (space >= 0) toks.push_back(space);
+    }
+    // codepoint scan with byte fallback at +3 (ref: src/tokenizer.cpp:155-192)
+    int32_t i = 0;
+    const int32_t nv = (int32_t)t->vocab.size();
+    while (i < text_len) {
+        int32_t j = i + 1;
+        while (j < text_len && (text[j] & 0xC0) == 0x80 && (j - i) < 4) j++;
+        std::string piece(reinterpret_cast<const char*>(text) + i, (size_t)(j - i));
+        int32_t tid = lookup(t, piece);
+        if (tid >= 0) {
+            toks.push_back(tid);
+        } else {
+            for (int32_t b = i; b < j; b++)
+                toks.push_back(text[b] + 3 < nv ? text[b] + 3 : 0);
+        }
+        i = j;
+    }
+    // greedy highest-score adjacent-pair merge (ref: src/tokenizer.cpp:195-223)
+    while (true) {
+        float best_score = -1e10f;
+        int32_t best_id = -1, best_idx = -1;
+        for (size_t k = 0; k + 1 < toks.size(); k++) {
+            std::string merged = t->vocab[toks[k]] + t->vocab[toks[k + 1]];
+            int32_t mid = lookup(t, merged);
+            if (mid >= 0 && t->scores[mid] > best_score) {
+                best_score = t->scores[mid];
+                best_id = mid;
+                best_idx = (int32_t)k;
+            }
+        }
+        if (best_idx < 0) break;
+        toks[best_idx] = best_id;
+        toks.erase(toks.begin() + best_idx + 1);
+    }
+    if (add_eos) toks.push_back(t->eos_id);
+    if ((int32_t)toks.size() > max_out) return -1;
+    std::memcpy(out, toks.data(), toks.size() * sizeof(int32_t));
+    return (int32_t)toks.size();
+}
+
+// Decode one piece given the previous token; returns byte length written.
+// Mirrors tokenizer.py:decode_piece (ref: src/tokenizer.cpp:89-100).
+int32_t dllama_tok_decode_piece(void* h, int32_t prev, int32_t tok,
+                                uint8_t* out, int32_t max_out) {
+    const Tokenizer* t = static_cast<Tokenizer*>(h);
+    if (tok < 0 || tok >= (int32_t)t->vocab.size()) return 0;
+    const std::string& p = t->vocab[tok];
+    const char* s = p.data();
+    size_t len = p.size();
+    if (prev == t->bos_id && len > 0 && s[0] == ' ') { s++; len--; }
+    // raw-byte pieces: "<0xAB>"
+    if (len == 6 && s[0] == '<' && s[1] == '0' && s[2] == 'x' && s[5] == '>') {
+        auto hex = [](char c) -> int {
+            if (c >= '0' && c <= '9') return c - '0';
+            if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+            if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+            return -1;
+        };
+        int hi = hex(s[3]), lo = hex(s[4]);
+        if (hi >= 0 && lo >= 0) {
+            if (max_out < 1) return -1;
+            out[0] = (uint8_t)(hi * 16 + lo);
+            return 1;
+        }
+    }
+    if ((int32_t)len > max_out) return -1;
+    std::memcpy(out, s, len);
+    return (int32_t)len;
+}
+
+// ------------------------------------------------------------------ sampler
+
+struct Sampler {
+    int32_t vocab_size;
+    float temperature;
+    float topp;
+    uint64_t state;
+};
+
+// xorshift* (ref: src/utils.cpp:53-64) — bit-exact with utils/rng.py
+static uint32_t rand_u32(uint64_t* s) {
+    *s ^= *s >> 12;
+    *s ^= *s << 25;
+    *s ^= *s >> 27;
+    return (uint32_t)((*s * 0x2545F4914F6CDD1DULL) >> 32);
+}
+static float rand_f32(uint64_t* s) {
+    return (float)(rand_u32(s) >> 8) / 16777216.0f;
+}
+
+void* dllama_sampler_create(int32_t vocab_size, float temperature, float topp,
+                            uint64_t seed) {
+    Sampler* sp = new Sampler{vocab_size, temperature, topp, seed};
+    return sp;
+}
+void dllama_sampler_free(void* h) { delete static_cast<Sampler*>(h); }
+void dllama_sampler_set_temp(void* h, float t) {
+    static_cast<Sampler*>(h)->temperature = t;
+}
+void dllama_sampler_set_seed(void* h, uint64_t seed) {
+    static_cast<Sampler*>(h)->state = seed;
+}
+uint64_t dllama_sampler_get_state(void* h) {
+    return static_cast<Sampler*>(h)->state;
+}
+void dllama_sampler_set_state(void* h, uint64_t state) {
+    static_cast<Sampler*>(h)->state = state;
+}
+
+// Greedy / temperature multinomial / top-p nucleus over `logits`
+// (ref: src/tokenizer.cpp:231-364). logits is scratch (not preserved).
+int32_t dllama_sampler_sample(void* h, float* logits) {
+    Sampler* sp = static_cast<Sampler*>(h);
+    const int32_t n = sp->vocab_size;
+    if (sp->temperature == 0.0f) {
+        int32_t best = 0;
+        for (int32_t i = 1; i < n; i++)
+            if (logits[i] > logits[best]) best = i;
+        return best;
+    }
+    // softmax with max-subtraction (ref: src/funcs.cpp:63-92) — same
+    // operation order as sampler.py (divide, max, exp, normalize) so the
+    // two implementations agree to float rounding
+    for (int32_t i = 0; i < n; i++) logits[i] /= sp->temperature;
+    float maxv = logits[0];
+    for (int32_t i = 1; i < n; i++) maxv = std::max(maxv, logits[i]);
+    double sum = 0.0;
+    for (int32_t i = 0; i < n; i++) {
+        logits[i] = std::exp(logits[i] - maxv);
+        sum += logits[i];
+    }
+    for (int32_t i = 0; i < n; i++) logits[i] = (float)(logits[i] / sum);
+
+    float coin = rand_f32(&sp->state);
+    if (sp->topp <= 0.0f || sp->topp >= 1.0f) {
+        double cdf = 0.0;
+        for (int32_t i = 0; i < n; i++) {
+            cdf += logits[i];
+            if ((double)coin < cdf) return i;
+        }
+        return n - 1;
+    }
+    // top-p: cutoff pre-filter, stable sort descending, truncate, sample
+    const float cutoff = (1.0f - sp->topp) / (float)(n - 1);
+    std::vector<int32_t> cand;
+    cand.reserve(256);
+    for (int32_t i = 0; i < n; i++)
+        if (logits[i] >= cutoff) cand.push_back(i);
+    std::stable_sort(cand.begin(), cand.end(), [&](int32_t a, int32_t b) {
+        return logits[a] > logits[b];
+    });
+    double cum = 0.0;
+    size_t last = cand.size() - 1;
+    for (size_t k = 0; k < cand.size(); k++) {
+        cum += logits[cand[k]];
+        if (cum > (double)sp->topp) { last = k; break; }
+    }
+    double total = 0.0;
+    for (size_t k = 0; k <= last; k++) total += logits[cand[k]];
+    double r = (double)coin * total;
+    double acc = 0.0;
+    for (size_t k = 0; k <= last; k++) {
+        acc += logits[cand[k]];
+        if (r < acc) return cand[k];
+    }
+    return cand[last];
+}
+
+}  // extern "C"
