@@ -44,11 +44,15 @@ def _rand_q40(rng: np.random.Generator, *shape: int) -> QuantizedTensor:
     """Random Q40 weight of logical shape (..., n): packed nibbles + scales
     sized so dequantized values land in a healthy ~N(0, 0.02) range.
     Generated directly in the device layout (..., 16*nb) flattened; scales
-    f32 as on device (quants/jax_codec.py)."""
+    as uint16 f16-bits as on device (quants/jax_codec.py)."""
     nb = shape[-1] // 32
     packed = rng.integers(0, 256, (*shape[:-1], 16 * nb), dtype=np.uint8)
     scales = (rng.random((*shape[:-1], nb), dtype=np.float32) * 0.004 + 0.001)
-    return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
+    sdt = os.environ.get("BENCH_SCALES", "u16")
+    if sdt == "f32":
+        return QuantizedTensor(jnp.asarray(packed), jnp.asarray(scales))
+    return QuantizedTensor(jnp.asarray(packed),
+                           jnp.asarray(scales.astype(np.float16).view(np.uint16)))
 
 
 def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict:
@@ -82,13 +86,14 @@ V5E_PEAK_BF16_TFLOPS = 197.0  # per chip; override with BENCH_PEAK_TFLOPS
 
 def _decode_read_bytes(spec: ModelSpec) -> int:
     """HBM bytes one decode step must read: every layer weight + wcls in
-    packed Q40 form (0.5625 B/weight + f32 scales on device), one embedding
+    packed Q40 form (0.5 B/weight + f16-bit scales on device), one embedding
     row, norms. The roofline denominator for effective-bandwidth."""
     d, h, kv, v = spec.dim, spec.hidden_dim, spec.kv_dim, spec.vocab_size
     per_layer_vals = d * d * 2 + kv * d * 2 + h * d * 2 + d * h
     total_vals = per_layer_vals * spec.n_layers + v * d  # + wcls
     packed = total_vals // 2               # device layout: 16 B per 32 nibbles
-    scales = total_vals // 32 * 4          # f32 block scales (separate array)
+    scale_w = 4 if os.environ.get("BENCH_SCALES") == "f32" else 2
+    scales = total_vals // 32 * scale_w    # uint16 f16-bit (or A/B f32) scales
     return packed + scales + d * 4 * (2 * spec.n_layers + 1) + d * 2
 
 
@@ -101,7 +106,9 @@ def _decode_flops(spec: ModelSpec) -> int:
 
 def main() -> None:
     model = os.environ.get("BENCH_MODEL", "7b")
-    n_tokens = int(os.environ.get("BENCH_TOKENS", "64"))
+    # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
+    # <0.3 ms/token and attention runs at realistic steady-state fill
+    n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
     spec = LLAMA2_7B if model == "7b" else TINY
 
     params = synth_q40_params(spec)
@@ -110,7 +117,13 @@ def main() -> None:
         compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
         max_seq_len=min(spec.seq_len, 2048))
 
-    _, dt = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
+    # best-of-N: the tunneled platform adds run-to-run jitter of ~1 ms/token
+    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
+    dt = None
+    for _ in range(repeats):
+        engine.pos = 0
+        _, d = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
+        dt = d if dt is None else min(dt, d)
     ms_per_token = dt / n_tokens * 1e3
 
     n_chips = 1

@@ -59,7 +59,7 @@ def _load():
         lib.dllama_sampler_set_state.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.dllama_sampler_sample.restype = ctypes.c_int32
         lib.dllama_sampler_sample.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
         _lib = lib
         return lib
     return None
@@ -149,7 +149,10 @@ class NativeSampler:
         self._lib.dllama_sampler_set_seed(self._h, seed & ((1 << 64) - 1))
 
     def sample(self, logits: np.ndarray) -> int:
-        x = np.ascontiguousarray(
-            np.asarray(logits, np.float32).reshape(-1)[: self.vocab_size])
+        # always copy: the C sampler scribbles softmax into the buffer, and
+        # the caller may hand us a read-only zero-copy view of a jax array
+        x = np.array(np.asarray(logits).reshape(-1)[: self.vocab_size],
+                     dtype=np.float32)
         return int(self._lib.dllama_sampler_sample(
-            self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+            self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            x.size))

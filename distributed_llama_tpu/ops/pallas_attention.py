@@ -17,6 +17,13 @@ decomposition), reset at block 0 and finalized at the last block.
 Causality: decode attends to all cache positions s <= pos (the cache is
 already updated at the query's position); positions beyond pos — including
 cache slots not yet written — are masked with -inf before the softmax.
+
+HBM scaling with context: pos rides in as a scalar-prefetch operand and the
+K/V index maps CLAMP the sequence-block index at the block containing pos —
+Mosaic skips the DMA when consecutive grid steps map to the same block, so
+the kernel reads ~pos bytes of cache, not the full preallocated seq_len
+(at 7B/seq 2048 that dead read was ~1 GB/token early in a session); the
+repeated block's scores are fully masked, and a pl.when skips its compute.
 """
 
 from __future__ import annotations
@@ -42,30 +49,35 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0]                                   # (G, hs)
-    k = k_ref[0]                                   # (SB, hs)
-    v = v_ref[0]
-
-    dot = functools.partial(
-        jax.lax.dot_general,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.DEFAULT,
-    )
-    scores = dot(q, k, dimension_numbers=(((1,), (1,)), ((), ()))) * scale  # (G, SB)
-
     b = pl.program_id(0) // kvh
     pos = pos_ref[b]
-    s_pos = j * sb + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-    scores = jnp.where(s_pos <= pos, scores, NEG_INF)
 
-    m_prev = m_ref[:]                              # (G, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(scores - m_new)                    # (G, SB); masked cols underflow to 0
-    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    pv = dot(p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())))
-    acc_ref[:] = acc_ref[:] * alpha + pv
-    m_ref[:] = m_new
+    # blocks entirely past pos are fully masked: their K/V DMA was clamped
+    # away (see index maps) and their compute is skipped
+    @pl.when(j * sb <= pos)
+    def _accumulate():
+        q = q_ref[0]                               # (G, hs)
+        k = k_ref[0]                               # (SB, hs)
+        v = v_ref[0]
+
+        dot = functools.partial(
+            jax.lax.dot_general,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
+        )
+        scores = dot(q, k, dimension_numbers=(((1,), (1,)), ((), ()))) * scale  # (G, SB)
+
+        s_pos = j * sb + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(s_pos <= pos, scores, NEG_INF)
+
+        m_prev = m_ref[:]                          # (G, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)                # (G, SB); masked cols underflow to 0
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = dot(p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())))
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
 
     @pl.when(j == n_sb - 1)
     def _done():
@@ -106,25 +118,31 @@ def flash_decode_attention(
     vh = v_cache.reshape(b * kvh, s, hs)
     pos = q_pos[:, 0].astype(jnp.int32)
 
+    def kv_index(i, j, pos_ref):
+        # clamp at the block containing pos[b]: steps past it re-map to the
+        # same block, so Mosaic elides their HBM copy (the dead-read fix)
+        return (i, jnp.minimum(j, pos_ref[i // kvh] // sb), 0)
+
     out = pl.pallas_call(
         functools.partial(
             _kernel, sb=sb, n_sb=n_sb, kvh=kvh,
             scale=1.0 / (hs ** 0.5), out_dtype=q.dtype),
-        grid=(b * kvh, n_sb),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, g, hs), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sb, hs), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sb, hs), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, g, hs), lambda i, j: (i, 0, 0),
-                               memory_space=pltpu.VMEM),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * kvh, n_sb),
+            in_specs=[
+                pl.BlockSpec((1, g, hs), lambda i, j, p: (i, 0, 0)),
+                pl.BlockSpec((1, sb, hs), kv_index),
+                pl.BlockSpec((1, sb, hs), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, g, hs), lambda i, j, p: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, hs), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct((b * kvh, g, hs), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((g, hs), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,

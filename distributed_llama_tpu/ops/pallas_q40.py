@@ -56,12 +56,31 @@ TILE_D_CANDIDATES = (1024, 512, 256, LANES)
 MAX_T = 256
 
 
+def _f16_bits_to_f32(u: jnp.ndarray) -> jnp.ndarray:
+    """Decode f16 bit patterns (int32-widened uint16) to f32 exactly with
+    integer ops + bitcast — Mosaic has no f16 arithmetic, and keeping the
+    scales 2 bytes wide in HBM saves ~10% of the kernel's traffic (measured
+    1.19x, tools/exp_scale_f16.py). Handles normals and subnormals; inf/nan
+    cannot occur in Q40 scales."""
+    sign = (u & 0x8000) << 16
+    e = (u >> 10) & 0x1F
+    m = u & 0x3FF
+    normal = jax.lax.bitcast_convert_type(
+        sign | ((e + 112) << 23) | (m << 13), jnp.float32)
+    sub = jnp.where(sign != 0, -1.0, 1.0) * (
+        m.astype(jnp.float32) * (2.0 ** -24))
+    return jnp.where(e == 0, sub, normal)
+
+
 def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
-            *, nb, out_dtype):
+            *, nb, out_dtype, scales_u16):
     pk = packed_ref[:].astype(jnp.int32)                 # (TD, M=16*nb)
     lo = (pk & 0xF).astype(jnp.float32)
     hi = (pk >> 4).astype(jnp.float32)
-    s = scales_ref[:]                                    # (TD, NB) f32 — Mosaic has no f16
+    if scales_u16:
+        s = _f16_bits_to_f32(scales_ref[:].astype(jnp.int32))  # (TD, NB)
+    else:
+        s = scales_ref[:]                                # f32 (hand-built)
     s16 = pltpu.repeat(s, 16, axis=1)                    # lane-tile -> (TD, M)
 
     # DEFAULT precision: single-pass MXU feed (HIGHEST = multi-pass f32
@@ -135,9 +154,12 @@ def q40_matmul(
     packed2d = w.packed  # already stored flattened (d, m) — consumed in place
     td = _tile_d(d, m)
     grid = (d // td,)
+    scales_u16 = w.scales.dtype == jnp.uint16
+    scales = w.scales if scales_u16 else w.scales.astype(jnp.float32)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, nb=nb, out_dtype=out_dtype),
+        functools.partial(_kernel, nb=nb, out_dtype=out_dtype,
+                          scales_u16=scales_u16),
         grid=grid,
         in_specs=[
             pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -154,6 +176,6 @@ def q40_matmul(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(x_lo, x_hi, xsum, packed2d, w.scales.astype(jnp.float32))
+    )(x_lo, x_hi, xsum, packed2d, scales)
 
     return out.reshape(*lead, d)

@@ -36,15 +36,19 @@ from .types import BLOCK_SIZE
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedTensor:
-    """Q40 tensor of logical shape (..., n): packed (..., n//2) u8 + scales (..., n//32) f32.
+    """Q40 tensor of logical shape (..., n): packed (..., n//2) u8 + scales
+    (..., n//32) stored as raw f16 BITS (uint16).
 
-    Scales are f16 in the file format but widened to f32 on device: Mosaic
-    has no f16, so f16 scales would force a convert+materialize per matmul
-    call — paying the widened read (+25% of packed bytes) once per token is
-    cheaper than converting per call."""
+    Scales are f16 in the file format and stay 2 bytes wide on device —
+    they are 1/8 of the packed bytes, so widening them to f32 costs ~10% of
+    the decode HBM traffic (measured 1.19x kernel slowdown). Mosaic has no
+    f16 arithmetic, so the kernel (and the XLA fallback) decode the bit
+    pattern exactly with integer ops / bitcast (`scales_to_float`).
+    f32 scales are still accepted anywhere a QuantizedTensor is built by
+    hand (tests, synthetic benches); consumers dispatch on dtype."""
 
     packed: jax.Array  # uint8
-    scales: jax.Array  # float32 on device (f16 in the .m file)
+    scales: jax.Array  # uint16 f16-bits on device (f16 in the .m file)
 
     def tree_flatten(self):
         return (self.packed, self.scales), None
@@ -74,20 +78,28 @@ class QuantizedTensor:
     @staticmethod
     def host_layout(scales: np.ndarray, packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Host block-major packed (..., nb, 16) -> the device layout as
-        numpy: (flattened (..., 16*nb) u8, f32 scales). Split out from
-        from_numpy so a sharded loader can jax.device_put the arrays with an
-        explicit NamedSharding instead of the default device."""
+        numpy: (flattened (..., 16*nb) u8, uint16 f16-bit scales). Split out
+        from from_numpy so a sharded loader can jax.device_put the arrays
+        with an explicit NamedSharding instead of the default device."""
         nb = packed.shape[-2]
         swapped = np.ascontiguousarray(packed.swapaxes(-1, -2))
         return (swapped.reshape(*swapped.shape[:-2], 16 * nb),
-                scales.astype(np.float32))
+                scales.astype(np.float16).view(np.uint16))
 
     @classmethod
     def from_numpy(cls, scales: np.ndarray, packed: np.ndarray) -> "QuantizedTensor":
         """Host block-major packed (..., nb, 16) -> device flattened (..., 16*nb);
-        f16 file scales widen to f32 (see class docstring)."""
+        f16 file scales stored as uint16 bits (see class docstring)."""
         pk, sc = cls.host_layout(scales, packed)
         return cls(jnp.asarray(pk), jnp.asarray(sc))
+
+
+def scales_to_float(scales: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Block scales -> float `dtype`; uint16 leaves are f16 bit patterns
+    (exact bitcast), float leaves pass through (hand-built tensors)."""
+    if scales.dtype == jnp.uint16:
+        return jax.lax.bitcast_convert_type(scales, jnp.float16).astype(dtype)
+    return scales.astype(dtype)
 
 
 def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
@@ -97,7 +109,7 @@ def dequantize_q40_jax(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
     lo = (pk & 0xF).astype(jnp.int8) - 8
     hi = (pk >> 4).astype(jnp.int8) - 8
     vals = jnp.concatenate([lo, hi], axis=-2)    # (..., 32, nb): k = h*16 + j
-    out = vals.astype(dtype) * t.scales[..., None, :].astype(dtype)
+    out = vals.astype(dtype) * scales_to_float(t.scales, dtype)[..., None, :]
     # dense[..., b*32 + k] = vals[..., k, b]
     out = jnp.swapaxes(out, -1, -2)
     return out.reshape(*out.shape[:-2], -1)

@@ -401,25 +401,32 @@ class Engine:
         the latency-optimal TPU decode path). Returns (tokens, seconds)."""
 
         spec = self.spec
+        key = ("greedy", n_tokens)
+        if key not in self._steps:
+            @partial(jax.jit, donate_argnums=(3,))
+            def run(params, tok0, pos0, cache):
+                def body(carry, _):
+                    tok, pos, cache = carry
+                    logits, cache = forward(
+                        params, spec, tok, pos, cache,
+                        activation_q80=self.activation_q80,
+                        compute_dtype=self.compute_dtype,
+                        use_pallas=self.use_pallas,
+                        tp_mesh=self._tp_mesh,
+                        sp_cache_mesh=self._sp_cache_mesh,
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt[:, None], pos + 1, cache), nxt
 
-        @partial(jax.jit, donate_argnums=(3,))
-        def run(params, tok0, pos0, cache):
-            def body(carry, _):
-                tok, pos, cache = carry
-                logits, cache = forward(
-                    params, spec, tok, pos, cache,
-                    activation_q80=self.activation_q80,
-                    compute_dtype=self.compute_dtype,
-                    use_pallas=self.use_pallas,
-                    tp_mesh=self._tp_mesh,
-                    sp_cache_mesh=self._sp_cache_mesh,
-                )
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (nxt[:, None], pos + 1, cache), nxt
+                (_, _, cache), toks = jax.lax.scan(
+                    body, (tok0, pos0, cache), None, length=n_tokens)
+                return toks, cache
 
-            (_, _, cache), toks = jax.lax.scan(
-                body, (tok0, pos0, cache), None, length=n_tokens)
-            return toks, cache
+            self._steps[key] = run
+            warm = True
+        else:
+            warm = False
+        run = self._steps[key]
 
         tok0 = jnp.full((self.batch, 1), first_token, jnp.int32)
         if self._token_sharding is not None:
@@ -427,10 +434,12 @@ class Engine:
 
         pos0 = jnp.int32(self.pos)
 
-        # compile + warm (excluded from timing); caches are donated, so each
-        # call gets a fresh one
-        toks, _ = run(self.params, tok0, pos0, self._new_cache())
-        _ = np.asarray(toks)  # sync via D2H transfer
+        if warm:
+            # compile + warm (excluded from timing); caches are donated, so
+            # each call gets a fresh one. Repeat calls (bench best-of-N) hit
+            # the cached executable and skip this.
+            toks, _ = run(self.params, tok0, pos0, self._new_cache())
+            _ = np.asarray(toks)  # sync via D2H transfer
 
         t0 = time.perf_counter()
         toks, cache = run(self.params, tok0, pos0, self._new_cache())

@@ -175,11 +175,13 @@ void dllama_sampler_set_state(void* h, uint64_t state) {
     static_cast<Sampler*>(h)->state = state;
 }
 
-// Greedy / temperature multinomial / top-p nucleus over `logits`
-// (ref: src/tokenizer.cpp:231-364). logits is scratch (not preserved).
-int32_t dllama_sampler_sample(void* h, float* logits) {
+// Greedy / temperature multinomial / top-p nucleus over `logits[0..n)`
+// (ref: src/tokenizer.cpp:231-364). logits is scratch (not preserved);
+// n is the buffer's actual length (may be < vocab_size — never read past).
+int32_t dllama_sampler_sample(void* h, float* logits, int32_t n) {
     Sampler* sp = static_cast<Sampler*>(h);
-    const int32_t n = sp->vocab_size;
+    if (n > sp->vocab_size) n = sp->vocab_size;
+    if (n <= 0) return 0;
     if (sp->temperature == 0.0f) {
         int32_t best = 0;
         for (int32_t i = 1; i < n; i++)

@@ -1,0 +1,135 @@
+"""Experiment: f16-bit scales (decoded in-kernel via integer ops) vs the
+current f32 scales — tests whether the kernel is HBM-bound enough that the
+~10% scale-traffic cut wins over the extra ~0.5 VPU ops/byte.
+
+Usage: python tools/exp_scale_f16.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from distributed_llama_tpu.ops.pallas_q40 import (
+    _f16_bits_to_f32,   # the SHIPPED decode — this tool measures that kernel
+    q40_matmul,
+    _split_activation,
+    _tile_d,
+)
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
+
+L, T = 32, 1
+D_OUT, D_IN = 11008 * 2, 4096  # w13-sized
+
+
+def _kernel_u16(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
+                *, nb, out_dtype):
+    pk = packed_ref[:].astype(jnp.int32)
+    lo = (pk & 0xF).astype(jnp.float32)
+    hi = (pk >> 4).astype(jnp.float32)
+    s = _f16_bits_to_f32(scales_ref[:].astype(jnp.int32))
+    s16 = pltpu.repeat(s, 16, axis=1)
+    dot = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+    acc = dot(x_lo_ref[:], lo * s16)
+    acc += dot(x_hi_ref[:], hi * s16)
+    acc += dot(xsum_ref[:], s) * -8.0
+    out_ref[:] = acc.astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def q40_matmul_u16(x, packed, scales_u16):
+    d, m = packed.shape
+    nb = m // 16
+    n = nb * 32
+    t = x.shape[0]
+    x_lo, x_hi = _split_activation(x.reshape(t, n).astype(jnp.float32), nb)
+    xsum = (x_lo + x_hi).reshape(t, 16, nb).sum(axis=1)
+    td = _tile_d(d, m)
+    return pl.pallas_call(
+        functools.partial(_kernel_u16, nb=nb, out_dtype=jnp.float32),
+        grid=(d // td,),
+        in_specs=[
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t, td), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+    )(x_lo, x_hi, xsum, packed, scales_u16)
+
+
+def bench(fn, args, reps=64) -> float:
+    @jax.jit
+    def run(x, *rest):
+        def body(c, _):
+            o = fn(c, *rest)
+            return c + o[:, :D_IN] * 1e-9, o  # feedback dep
+        c, o = jax.lax.scan(body, x, None, length=reps)
+        return c
+    out = run(*args)
+    np.asarray(out)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(run(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / reps
+
+
+def main():
+    rng = np.random.default_rng(0)
+    layers = []
+    for _ in range(L):
+        packed = rng.integers(0, 256, (D_OUT, 16 * (D_IN // 32)), dtype=np.uint8)
+        sc = (rng.random((D_OUT, D_IN // 32), dtype=np.float32) * 0.004 + 0.001)
+        layers.append((jnp.asarray(packed),
+                       jnp.asarray(sc),
+                       jnp.asarray(sc.astype(np.float16).view(np.uint16))))
+    x = jnp.asarray(rng.standard_normal((T, D_IN), dtype=np.float32))
+
+    packed_b = sum(l[0].nbytes for l in layers)
+    f32_b = packed_b + sum(l[1].nbytes for l in layers)
+    u16_b = packed_b + sum(l[2].nbytes for l in layers)
+
+    def run_f32(x):
+        o = None
+        for p, s, _ in layers:
+            o = q40_matmul(x, QuantizedTensor(p, s))
+        return o
+
+    def run_u16(x):
+        o = None
+        for p, _, su in layers:
+            o = q40_matmul_u16(x, p, su)
+        return o
+
+    # correctness first
+    a = np.asarray(q40_matmul(x, QuantizedTensor(layers[0][0], layers[0][1])))
+    b = np.asarray(q40_matmul_u16(x, layers[0][0],
+                                  jnp.asarray(np.asarray(layers[0][1]).astype(np.float16).view(np.uint16))))
+    err = np.abs(a - b).max() / max(np.abs(a).max(), 1e-9)
+    print(f"rel err u16 vs f32 scales: {err:.2e}")
+
+    t_f32 = bench(lambda x: run_f32(x), (x,), reps=16)
+    t_u16 = bench(lambda x: run_u16(x), (x,), reps=16)
+    print(f"f32 scales: {t_f32*1e3:7.3f} ms  ({f32_b/t_f32/1e9:6.1f} GB/s total)")
+    print(f"u16 scales: {t_u16*1e3:7.3f} ms  ({u16_b/t_u16/1e9:6.1f} GB/s total)")
+    print(f"speedup: {t_f32/t_u16:.3f}x")
+
+
+if __name__ == "__main__":
+    main()
