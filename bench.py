@@ -110,6 +110,9 @@ def main() -> None:
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
     spec = LLAMA2_7B if model == "7b" else TINY
+    # decode must fit the KV cache: decode_greedy_device has no per-step
+    # overflow guard, so steps past seq_len would silently measure garbage
+    n_tokens = min(n_tokens, spec.seq_len - 1)
 
     params = synth_q40_params(spec)
     engine = Engine(

@@ -64,9 +64,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--cache-dtype", default="bf16", choices=["bf16", "f32"])
-    p.add_argument("--pallas", action="store_true",
-                   help="opt into the fused Q40 Pallas kernel (default: XLA "
-                        "dequant path, which currently measures at parity)")
+    p.add_argument("--pallas", action="store_true", default=None,
+                   help="force the fused Pallas kernels on (default: on for "
+                        "TPU backends, including multi-device meshes via "
+                        "shard_map; off on CPU where Mosaic can't compile)")
+    p.add_argument("--no-pallas", dest="pallas", action="store_false",
+                   help="force the XLA dequant path instead of the Pallas "
+                        "kernels")
     p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
     return p
 
@@ -120,7 +124,7 @@ def build_engine(args):
         compute_dtype=cdt, cache_dtype=kdt,
         activation_q80=(args.buffer_float_type == "q80" and mode == "q40"),
         q80_collectives=(args.buffer_float_type == "q80"),
-        use_pallas=bool(args.pallas),
+        use_pallas=args.pallas,  # None -> engine default (on for TPU)
     )
 
     tokenizer = Tokenizer.from_file(args.tokenizer)

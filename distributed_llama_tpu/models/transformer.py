@@ -139,9 +139,21 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
 
         att = sp_cache_attention(q, k_cache, v_cache, q_pos, sp_cache_mesh)
     elif t == 1 and cfg.get("use_pallas"):
-        from ..ops.pallas_attention import flash_decode_attention
+        if cfg.get("tp_mesh") is not None:
+            # multi-device mesh: GSPMD can't partition a pallas_call, so the
+            # kernel runs per-shard inside shard_map (dp on batch, tp on
+            # kv-heads — head-local, no collective)
+            from ..parallel.tp_q80 import tp_flash_attention
 
-        att = flash_decode_attention(q, k_cache, v_cache, q_pos)
+            att = tp_flash_attention(
+                q, k_cache, v_cache, q_pos, cfg["tp_mesh"],
+                interpret=cfg.get("pallas_interpret", False))
+        else:
+            from ..ops.pallas_attention import flash_decode_attention
+
+            att = flash_decode_attention(
+                q, k_cache, v_cache, q_pos,
+                interpret=cfg.get("pallas_interpret", False))
     else:
         att = decode_attention(q, k_cache, v_cache, q_pos)  # (B, T, H, hs)
     out = matmul(att.reshape(b, t, h * hs), lw["wo"], **cfg)
@@ -222,10 +234,12 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
 def _take_expert(w, e):
     """Select expert e from a stacked (E, ...) weight (dense or Q40; for
     TpColWeight the expert axis sits behind the tp stack axis)."""
-    from ..parallel.tp_q80 import TpColWeight, take_expert_col
+    from ..parallel.tp_q80 import TpColWeight, TpRowWeight, take_expert_col
 
     if isinstance(w, TpColWeight):
         return take_expert_col(w, e)
+    if isinstance(w, TpRowWeight):
+        return TpRowWeight(_take_expert(w.w, e))
     if isinstance(w, QuantizedTensor):
         return QuantizedTensor(
             lax.dynamic_index_in_dim(w.packed, e, axis=0, keepdims=False),
@@ -273,6 +287,8 @@ def forward(
     use_pallas: bool = False,
     sp_mesh=None,
     tp_mesh=None,
+    tp_reduce: str = "exact",
+    pallas_interpret: bool = False,
     sp_cache_mesh=None,
     logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
@@ -283,14 +299,16 @@ def forward(
     right-padded), or (B, T, vocab) if logits_for_all.
     sp_mesh: a Mesh whose sp axis shards this segment's sequence — enables the
     ring-attention prefill path (segment must start at pos 0).
-    tp_mesh: a Mesh for the q80-collective TP mode (col weights repacked as
-    TpColWeight; see parallel/tp_q80.py).
+    tp_mesh: a Mesh for the explicit shard_map TP paths (weights marked as
+    TpRowWeight/TpColWeight; Pallas kernels per shard, col partial sums
+    reduced per tp_reduce — see parallel/tp_q80.py).
     sp_cache_mesh: a Mesh whose sp axis shards the KV cache's sequence dim
     (cache_pspec(sp=True)) — cache writes keep that sharding and attention
     reads it chunk-wise (parallel/ring_attention.py:sp_cache_attention).
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
-               use_pallas=use_pallas, tp_mesh=tp_mesh)
+               use_pallas=use_pallas, tp_mesh=tp_mesh, tp_reduce=tp_reduce,
+               pallas_interpret=pallas_interpret)
     b, t = tokens.shape
 
     x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203

@@ -23,6 +23,36 @@ from ..quants.jax_codec import QuantizedTensor, dequantize_q40_jax, quantize_q80
 WeightFormat = Union[jnp.ndarray, QuantizedTensor]
 
 
+def local_matmul(
+    x: jnp.ndarray,
+    w: WeightFormat,
+    *,
+    compute_dtype,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-device matmul core: Pallas fused Q40 kernel when the operands
+    qualify, XLA dequant einsum otherwise. Shared by matmul() and the
+    shard_map per-shard bodies (parallel/tp_q80.py) so the kernel
+    preconditions and fallback live in exactly one place."""
+    x = x.astype(compute_dtype)
+    if isinstance(w, QuantizedTensor):
+        if use_pallas:
+            from .pallas_q40 import q40_matmul, supports_pallas
+
+            t = 1
+            for s in x.shape[:-1]:
+                t *= s
+            if supports_pallas(w, t):
+                return q40_matmul(x, w, out_dtype=compute_dtype,
+                                  interpret=interpret)
+        wd = dequantize_q40_jax(w, dtype=compute_dtype)
+    else:
+        wd = w.astype(compute_dtype)
+    return jnp.einsum("...n,dn->...d", x, wd,
+                      preferred_element_type=compute_dtype)
+
+
 def matmul(
     x: jnp.ndarray,
     w: WeightFormat,
@@ -31,6 +61,8 @@ def matmul(
     compute_dtype=jnp.float32,
     use_pallas: bool = False,
     tp_mesh=None,
+    tp_reduce: str = "exact",
+    pallas_interpret: bool = False,
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
@@ -41,9 +73,10 @@ def matmul(
     use_pallas=True routes Q40 weights through the fused dequant-matmul TPU
     kernel (ops/pallas_q40.py) when its layout preconditions hold.
 
-    tp_mesh: mesh for the q80-collective mode — col-split weights arrive as
-    TpColWeight stacks and run the shard_map partial-sum path with the
-    Q80-compressed all-reduce (parallel/tp_q80.py).
+    tp_mesh: mesh for the explicit shard_map execution paths — weights
+    arrive as TpRowWeight (row-split, communication-free) or TpColWeight
+    (col-split partial sums, reduced per tp_reduce: "exact" psum or the
+    reference's "q80" compressed exchange) — parallel/tp_q80.py.
     """
     if activation_q80:
         q, scales = quantize_q80_jax(x)
@@ -51,23 +84,19 @@ def matmul(
     else:
         x = x.astype(compute_dtype)
 
-    from ..parallel.tp_q80 import TpColWeight, tp_col_matmul
+    from ..parallel.tp_q80 import (
+        TpColWeight, TpRowWeight, tp_col_matmul, tp_row_matmul)
 
     if isinstance(w, TpColWeight):
         assert tp_mesh is not None, "TpColWeight requires the mesh in cfg"
-        return tp_col_matmul(x, w, tp_mesh, compute_dtype=compute_dtype)
+        return tp_col_matmul(x, w, tp_mesh, compute_dtype=compute_dtype,
+                             reduce=tp_reduce, use_pallas=use_pallas,
+                             interpret=pallas_interpret)
+    if isinstance(w, TpRowWeight):
+        assert tp_mesh is not None, "TpRowWeight requires the mesh in cfg"
+        return tp_row_matmul(x, w, tp_mesh, compute_dtype=compute_dtype,
+                             use_pallas=use_pallas,
+                             interpret=pallas_interpret)
 
-    if isinstance(w, QuantizedTensor):
-        if use_pallas:
-            from .pallas_q40 import q40_matmul, supports_pallas
-
-            t = 1
-            for s in x.shape[:-1]:
-                t *= s
-            if supports_pallas(w, t):
-                return q40_matmul(x, w, out_dtype=compute_dtype)
-        wd = dequantize_q40_jax(w, dtype=compute_dtype)
-    else:
-        wd = w.astype(compute_dtype)
-
-    return jnp.einsum("...n,dn->...d", x, wd, preferred_element_type=compute_dtype)
+    return local_matmul(x, w, compute_dtype=compute_dtype,
+                        use_pallas=use_pallas, interpret=pallas_interpret)

@@ -75,11 +75,15 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
 
 
 def _leaf_spec(name: str, w):
-    from .tp_q80 import TpColWeight, tp_col_pspec
+    from .tp_q80 import TpColWeight, TpRowWeight, tp_col_pspec, tp_row_pspec
 
     if isinstance(w, TpColWeight):
         # q80-collective mode: col weights are pre-stacked (tp, ..., d, n/tp)
         return tp_col_pspec(w)
+    if isinstance(w, TpRowWeight):
+        # shard_map-kernel mode: output rows on tp, matching the in_specs of
+        # tp_row_matmul so entering the shard_map moves no bytes
+        return tp_row_pspec(w)
     if isinstance(w, QuantizedTensor):
         return QuantizedTensor(  # pytree-shaped specs
             _pspec_for(name, w.packed.ndim, True, "packed"),
@@ -154,6 +158,29 @@ def repack_col_weights(params: dict, tp: int) -> dict:
     return out
 
 
+def wrap_row_weights(params: dict) -> dict:
+    """Mark every remaining Q40 matmul weight as TpRowWeight so matmul()
+    routes it through the shard_map Pallas path (parallel/tp_q80.py). Run
+    AFTER repack_col_weights when tp > 1 — col-split weights must already be
+    TpColWeight stacks; with tp == 1 (dp-only meshes) col weights are
+    unsplit and row-wrapping them is correct (marker only, no sharding)."""
+    from .tp_q80 import TpRowWeight
+
+    def wrap(name, v):
+        if (name in _SPLIT and _SPLIT[name] is not None
+                and isinstance(v, QuantizedTensor)):
+            return TpRowWeight(v)
+        return v
+
+    out = dict(params)
+    out["layers"] = [
+        {k: wrap(k, v) for k, v in lw.items()} for lw in params["layers"]
+    ]
+    if isinstance(out.get("wcls"), QuantizedTensor):
+        out["wcls"] = TpRowWeight(out["wcls"])
+    return out
+
+
 def shard_params(params: dict, mesh) -> dict:
     """device_put every leaf with its NamedSharding (sharded weight placement —
     the analogue of the reference's per-worker weight push at load,
@@ -164,10 +191,10 @@ def shard_params(params: dict, mesh) -> dict:
         return jax.device_put(w, NamedSharding(mesh, s))
 
     def put_entry(w, sp):
-        from .tp_q80 import TpColWeight
+        from .tp_q80 import TpColWeight, TpRowWeight
 
-        if isinstance(w, TpColWeight):
-            return TpColWeight(put_entry(w.w, sp.w))
+        if isinstance(w, (TpColWeight, TpRowWeight)):
+            return type(w)(put_entry(w.w, sp.w))
         if isinstance(w, QuantizedTensor):
             return QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))
         return put(w, sp)

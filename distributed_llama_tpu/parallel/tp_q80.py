@@ -1,12 +1,26 @@
-"""Q80-compressed tensor-parallel col-split matmul (shard_map path).
+"""Explicit tensor-parallel execution paths (shard_map layer).
 
-The reference quantizes every inter-node activation transfer to Q80 int8
-blocks (ref: src/tasks.cpp:124-163), invoked around each layer's wo/w2
-partial-sum exchange (ref: src/llama2-tasks.cpp:251-274) — its signature
-wire optimization (README measures 2048 kB -> 544 kB per token). Under pure
-GSPMD the col-split contraction's all-reduce is compiler-inserted and always
-exact/full-precision; this module is the explicit execution path where that
-reduction moves int8 blocks instead, selected by `--buffer-float-type q80`.
+Two things GSPMD cannot express live here:
+
+1. **Q80-compressed partial-sum exchange.** The reference quantizes every
+   inter-node activation transfer to Q80 int8 blocks (ref:
+   src/tasks.cpp:124-163), invoked around each layer's wo/w2 partial-sum
+   exchange (ref: src/llama2-tasks.cpp:251-274) — its signature wire
+   optimization (README measures 2048 kB -> 544 kB per token). Under pure
+   GSPMD the col-split contraction's all-reduce is compiler-inserted and
+   always exact/full-precision; `tp_col_matmul(reduce="q80")` is the
+   execution path where that reduction moves int8 blocks instead, selected
+   by `--buffer-float-type q80`.
+
+2. **Pallas kernels on multi-device meshes.** GSPMD cannot auto-partition
+   a `pallas_call` over sharded operands, so the fused Q40 kernel
+   (ops/pallas_q40.py) and flash decode attention (ops/pallas_attention.py)
+   would otherwise force the slower XLA-dequant path whenever the mesh has
+   more than one device. `tp_row_matmul` / `tp_col_matmul(use_pallas=True)`
+   / `tp_flash_attention` run the kernels per-shard inside `shard_map`:
+   row-split weights need no communication at all (each shard produces its
+   output rows), col-split partial sums reduce with an exact psum (default)
+   or the quantized exchange, and attention shards over (dp, kv-heads).
 
 Layout: a col-split weight (wo, w2, moe_down — ref ColMatmulSlice,
 src/transformer.cpp:48-76) is repacked host/device-side into a stacked
@@ -26,7 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..quants.jax_codec import QuantizedTensor, dequantize_q40_jax
+from ..ops.matmul import local_matmul
+from ..quants.jax_codec import QuantizedTensor
 from .collectives import q80_psum_2shot
 from .mesh import DP_AXIS, SP_AXIS, TP_AXIS
 
@@ -49,6 +64,124 @@ class TpColWeight:
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TpRowWeight:
+    """A row-split (output-dim) matmul weight, marked for shard_map kernel
+    execution. No repacking: the d axis shards contiguously, so each local
+    block is itself a valid weight for its output rows (the reference's
+    RowMatmulSlice, ref: src/transformer.cpp:14-46). With tp == 1 (dp-only
+    meshes) the weight is replicated and the marker only routes the matmul
+    through shard_map so the Pallas kernel sees local (unsharded) operands."""
+
+    w: QuantizedTensor | jax.Array
+
+    def tree_flatten(self):
+        return (self.w,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def tp_row_pspec(w: TpRowWeight) -> TpRowWeight:
+    """PartitionSpec pytree: the output-row axis (-2) on tp, rest replicated.
+    Packed (lead..., d, m), scales (lead..., d, nb) and dense (lead..., d, n)
+    all shard the same axis."""
+    def spec(ndim):
+        axes: list = [None] * ndim
+        axes[ndim - 2] = TP_AXIS
+        return P(*axes)
+
+    if isinstance(w.w, QuantizedTensor):
+        return TpRowWeight(QuantizedTensor(spec(w.w.packed.ndim),
+                                           spec(w.w.scales.ndim)))
+    return TpRowWeight(spec(w.w.ndim))
+
+
+def _batch_axes(mesh, x):
+    """(dp_ax, sp_ax) usable for this x's leading dims on this mesh."""
+    dp = mesh.shape.get(DP_AXIS, 1)
+    sp = mesh.shape.get(SP_AXIS, 1)
+    b = x.shape[0]
+    t = x.shape[1] if x.ndim == 3 else 1
+    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
+    sp_ax = (SP_AXIS if x.ndim == 3 and sp > 1 and t > 1 and t % sp == 0
+             else None)
+    return dp_ax, sp_ax
+
+
+def tp_row_matmul(
+    x: jnp.ndarray,
+    w: TpRowWeight,
+    mesh,
+    *,
+    compute_dtype=jnp.float32,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """y[..., d] = x @ W^T with the OUTPUT dim tp-split — communication-free
+    (each shard computes its own output rows; the result stays tp-sharded on
+    the last axis, which is exactly how downstream consumers want it: heads
+    for attention, hidden columns for w2's col-split contraction).
+
+    x is (B, n) or (B, T, n), replicated over tp (the reference likewise
+    gives every node the full normed activation, ref: llama2-tasks.cpp:249).
+    """
+    from jax import shard_map
+
+    tp = mesh.shape.get(TP_AXIS, 1)
+    tp_ax = TP_AXIS if tp > 1 else None
+    dp_ax, sp_ax = _batch_axes(mesh, x)
+    if x.ndim == 2:
+        x_spec, out_spec = P(dp_ax, None), P(dp_ax, tp_ax)
+    else:
+        x_spec, out_spec = P(dp_ax, sp_ax, None), P(dp_ax, sp_ax, tp_ax)
+
+    def body(x_l, w_l):
+        return local_matmul(x_l, w_l.w, compute_dtype=compute_dtype,
+                            use_pallas=use_pallas, interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(x_spec, tp_row_pspec(w)),
+                   out_specs=out_spec, check_vma=False)
+    return fn(x, w)
+
+
+def tp_flash_attention(
+    q: jnp.ndarray,        # (B, 1, H, hs)
+    k_cache: jnp.ndarray,  # (B, KVH, S, hs)
+    v_cache: jnp.ndarray,  # (B, KVH, S, hs)
+    q_pos: jnp.ndarray,    # (B, 1)
+    mesh,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """flash_decode_attention over a (dp, tp) mesh: batch shards on dp,
+    heads/kv-heads on tp (the reference's KvCacheSlice head split,
+    ref: src/transformer.cpp:161-171). Pure shard-local — attention never
+    mixes heads, so no collective is needed."""
+    from jax import shard_map
+
+    from ..ops.pallas_attention import flash_decode_attention
+
+    b = q.shape[0]
+    dp = mesh.shape.get(DP_AXIS, 1)
+    tp = mesh.shape.get(TP_AXIS, 1)
+    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
+    tp_ax = TP_AXIS if tp > 1 else None
+
+    def body(q_l, k_l, v_l, pos_l):
+        return flash_decode_attention(q_l, k_l, v_l, pos_l,
+                                      interpret=interpret)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(dp_ax, None, tp_ax, None), P(dp_ax, tp_ax, None, None),
+                  P(dp_ax, tp_ax, None, None), P(dp_ax, None)),
+        out_specs=P(dp_ax, None, tp_ax, None), check_vma=False)
+    return fn(q, k_cache, v_cache, q_pos)
 
 
 def repack_col_tp(w, tp: int) -> TpColWeight:
@@ -101,23 +234,23 @@ def tp_col_matmul(
     mesh,
     *,
     compute_dtype=jnp.float32,
+    reduce: str = "q80",
+    use_pallas: bool = False,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """y[b, t, d] = sum_n x[b, t, n] * W[d, n] with the contraction tp-split
-    and the partial-sum reduction Q80-compressed.
+    and the partial sums reduced exactly (`reduce="exact"`, jax.lax.psum) or
+    Q80-compressed (`reduce="q80"`, the reference's wire optimization).
 
     x is a global (B, T, n) array (GSPMD-resident); the shard_map forces the
     last dim onto tp (matching how row-split producers already shard it), the
-    local (B_l, T_l, n/tp) x slice contracts with this shard's weight slice,
-    and partials all-reduce via the quantized two-shot exchange. Output is
-    (B, T, d), replicated over tp like the GSPMD-exact path's all-reduce."""
+    local (B_l, T_l, n/tp) x slice contracts with this shard's weight slice
+    (Pallas fused Q40 kernel when use_pallas), and partials all-reduce.
+    Output is (B, T, d), replicated over tp like GSPMD's own all-reduce."""
     from jax import shard_map
 
     tp = mesh.shape[TP_AXIS]
-    b, t, _ = x.shape
-    dp = mesh.shape.get(DP_AXIS, 1)
-    sp = mesh.shape.get(SP_AXIS, 1)
-    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
-    sp_ax = SP_AXIS if sp > 1 and t > 1 and t % sp == 0 else None
+    dp_ax, sp_ax = _batch_axes(mesh, x)
     x_spec = P(dp_ax, sp_ax, TP_AXIS)
     out_spec = P(dp_ax, sp_ax, None)
 
@@ -125,11 +258,12 @@ def tp_col_matmul(
         wk = w_l.w
         if isinstance(wk, QuantizedTensor):
             wk = QuantizedTensor(wk.packed[0], wk.scales[0])
-            wd = dequantize_q40_jax(wk, dtype=compute_dtype)
         else:
-            wd = wk[0].astype(compute_dtype)
-        partial = jnp.einsum("btn,dn->btd", x_l.astype(compute_dtype), wd,
-                             preferred_element_type=compute_dtype)
+            wk = wk[0]
+        partial = local_matmul(x_l, wk, compute_dtype=compute_dtype,
+                                use_pallas=use_pallas, interpret=interpret)
+        if reduce == "exact":
+            return jax.lax.psum(partial, TP_AXIS) if tp > 1 else partial
         return q80_psum_2shot(partial, TP_AXIS, tp)
 
     fn = shard_map(body, mesh=mesh, in_specs=(x_spec, tp_col_pspec(w)),

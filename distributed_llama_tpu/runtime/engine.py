@@ -53,6 +53,7 @@ class Engine:
         q80_collectives: bool | None = None,
         prefill_chunk: int = 128,
         use_pallas: bool | None = None,
+        pallas_interpret: bool = False,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -84,14 +85,20 @@ class Engine:
             # 5.0 ms XLA-dequant for the same 0.81 GB packed weight set);
             # prefill segments longer than pallas_q40.MAX_T fall back to the
             # FLOPs-amortized XLA dequant path automatically. On CPU (tests,
-            # virtual meshes) Mosaic can't compile — use the XLA path.
-            use_pallas = jax.default_backend() != "cpu"
-        if mesh is not None and mesh.size > 1:
-            # GSPMD cannot auto-partition Pallas custom calls over sharded
-            # operands (tp-sharded weights, dp-sharded cache/activations) —
-            # multi-device meshes use the XLA dequant + fused-attention path
-            use_pallas = False
+            # virtual meshes) Mosaic can't compile — use the XLA path unless
+            # pallas_interpret forces the interpreted kernel (tests).
+            use_pallas = jax.default_backend() != "cpu" or pallas_interpret
         self.use_pallas = use_pallas
+        self.pallas_interpret = pallas_interpret
+        # GSPMD cannot auto-partition a pallas_call over sharded operands, so
+        # multi-device meshes run the kernels per-shard via shard_map
+        # (parallel/tp_q80.py): Q40 weights are marked TpRowWeight/TpColWeight
+        # and attention shards over (dp, kv-heads). The col partial-sum
+        # reduce is exact unless q80 collectives are on.
+        mesh_kernels = use_pallas and mesh is not None and mesh.size > 1
+        self.tp_reduce = "q80" if self.q80_collectives else "exact"
+        if mesh_kernels:
+            self._tp_mesh = mesh
 
         if tp == 1:
             # single-shard fast path: fused QKV / w1|w3 kernel calls
@@ -102,10 +109,14 @@ class Engine:
             q40 = any(isinstance(v, QuantizedTensor)
                       for lw in params["layers"] for v in lw.values())
             check_tp_constraints(spec, tp, q40=q40)
-            if self.q80_collectives:
+            if self.q80_collectives or (mesh_kernels and tp > 1 and q40):
                 from ..parallel.sharding import repack_col_weights
 
                 params = repack_col_weights(params, tp)
+            if mesh_kernels and q40:
+                from ..parallel.sharding import wrap_row_weights
+
+                params = wrap_row_weights(params)
             self.params = shard_params(params, mesh)
             self._cache_sharding = NamedSharding(mesh, cache_pspec(sp=sp > 1))
             self._token_sharding = NamedSharding(mesh, P(DP_AXIS, None))
@@ -172,6 +183,20 @@ class Engine:
 
     # -- compiled steps ---------------------------------------------------
 
+    def _forward_kwargs(self) -> dict:
+        """The engine's forward() configuration, in exactly one place — every
+        execution path (compiled steps, the on-device greedy scan) must build
+        its kwargs here so a new forward() knob is threaded once."""
+        return dict(
+            activation_q80=self.activation_q80,
+            compute_dtype=self.compute_dtype,
+            use_pallas=self.use_pallas,
+            tp_mesh=self._tp_mesh,
+            tp_reduce=self.tp_reduce,
+            pallas_interpret=self.pallas_interpret,
+            sp_cache_mesh=self._sp_cache_mesh,
+        )
+
     def _compiled_step(self, key, *, sp_mesh=None,
                        with_logit_index: bool = False) -> Callable:
         """One cached jitted forward wrapper for every execution path.
@@ -184,13 +209,7 @@ class Engine:
         if key in self._steps:
             return self._steps[key]
 
-        common = dict(
-            activation_q80=self.activation_q80,
-            compute_dtype=self.compute_dtype,
-            use_pallas=self.use_pallas,
-            tp_mesh=self._tp_mesh,
-            sp_cache_mesh=self._sp_cache_mesh,
-        )
+        common = self._forward_kwargs()
         if with_logit_index:
             def run(params, tokens, logit_index, cache):
                 return forward(params, self.spec, tokens, jnp.int32(0), cache,
@@ -403,18 +422,14 @@ class Engine:
         spec = self.spec
         key = ("greedy", n_tokens)
         if key not in self._steps:
+            common = self._forward_kwargs()
+
             @partial(jax.jit, donate_argnums=(3,))
             def run(params, tok0, pos0, cache):
                 def body(carry, _):
                     tok, pos, cache = carry
                     logits, cache = forward(
-                        params, spec, tok, pos, cache,
-                        activation_q80=self.activation_q80,
-                        compute_dtype=self.compute_dtype,
-                        use_pallas=self.use_pallas,
-                        tp_mesh=self._tp_mesh,
-                        sp_cache_mesh=self._sp_cache_mesh,
-                    )
+                        params, spec, tok, pos, cache, **common)
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     return (nxt[:, None], pos + 1, cache), nxt
 
