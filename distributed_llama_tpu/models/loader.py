@@ -30,8 +30,10 @@ from ..quants.jax_codec import QuantizedTensor
 from ..quants.numpy_codec import quantize_q40
 from ..quants.types import FloatType
 from ..parallel.sharding import COL_SPLIT_NAMES, _pspec_for
-from ..parallel.mesh import TP_AXIS
+from ..parallel.mesh import EP_AXIS, TP_AXIS
 from .spec import ArchType, ModelSpec
+
+_MOE_EP_KEYS = ("moe_up", "moe_gate", "moe_down")
 
 
 class LoadStats(NamedTuple):
@@ -64,14 +66,19 @@ def _to_q40_host(x: np.ndarray) -> HostTensor:
 
 class _Placer:
     """Converts one host tensor (or fusion group) to device arrays with the
-    right NamedSharding, tracking q80-collective col repacking."""
+    right NamedSharding, tracking q80-collective col repacking and
+    expert-parallel (ep) placement — each device receives only its E/ep
+    experts' shards directly, so peak per-device expert memory at load is
+    E/(ep*tp), never full-E (the point of placement-EP)."""
 
-    def __init__(self, mesh, mode: str, dtype, tp: int, q80_collectives: bool):
+    def __init__(self, mesh, mode: str, dtype, tp: int, q80_collectives: bool,
+                 ep: int = 1):
         self.mesh = mesh
         self.mode = mode
         self.dtype = dtype
         self.tp = tp
         self.q80 = q80_collectives and tp > 1
+        self.ep = ep
 
     def _put(self, x: np.ndarray, pspec):
         if self.mesh is None:
@@ -83,24 +90,34 @@ class _Placer:
 
     def weight(self, key: str, ts: list[HostTensor]):
         """A matmul weight: single tensor, or an E-stacked expert list.
-        Applies mode (dense/q40), col repack for q80 collectives, sharding."""
+        Applies mode (dense/q40), col repack for q80 collectives, ep
+        placement for MoE expert stacks, sharding."""
         stacked = len(ts) > 1
+        moe_ep = self.ep > 1 and key in _MOE_EP_KEYS
         if self.mode != "q40":
             x = np.stack([t.to_f32() for t in ts]) if stacked else ts[0].to_f32()
             x = x.astype(np.dtype(self.dtype) if self.dtype != jnp.bfloat16
                          else np.float32)
-            if self.q80 and key in COL_SPLIT_NAMES:
+            if (self.q80 or moe_ep) and key in COL_SPLIT_NAMES:
                 n = x.shape[-1]
                 xs = x.reshape(*x.shape[:-1], self.tp, n // self.tp)
                 xs = np.moveaxis(xs, -2, 0)
+                from ..parallel.ep_moe import EpColWeight
                 from ..parallel.tp_q80 import TpColWeight
 
-                ndim = xs.ndim
+                wrap = EpColWeight if moe_ep else TpColWeight
                 arr = self._put(np.ascontiguousarray(xs),
-                                _col_stack_pspec(ndim))
-                return TpColWeight(
+                                _col_stack_pspec(xs.ndim, ep=moe_ep))
+                return wrap(
                     arr if self.dtype != jnp.bfloat16
                     else arr.astype(jnp.bfloat16))
+            if moe_ep:
+                from ..parallel.ep_moe import EpRowWeight
+
+                arr = self._put(x, _ep_row_pspec(x.ndim))
+                return EpRowWeight(
+                    arr.astype(self.dtype) if self.dtype == jnp.bfloat16
+                    else arr)
             arr = self._put(x, _pspec_for(key, x.ndim, False, "dense"))
             return arr.astype(self.dtype) if self.dtype == jnp.bfloat16 else arr
 
@@ -108,18 +125,28 @@ class _Placer:
               for t in ts]
         packed = np.stack([q.packed for q in qs]) if stacked else qs[0].packed
         scales = np.stack([q.scales for q in qs]) if stacked else qs[0].scales
-        if self.q80 and key in COL_SPLIT_NAMES:
-            return self._col_q40(packed, scales)
+        if (self.q80 or moe_ep) and key in COL_SPLIT_NAMES:
+            return self._col_q40(packed, scales, ep=moe_ep)
         pk, sc = QuantizedTensor.host_layout(scales, packed)
+        if moe_ep:
+            from ..parallel.ep_moe import EpRowWeight
+
+            return EpRowWeight(QuantizedTensor(
+                self._put(pk, _ep_row_pspec(pk.ndim)),
+                self._put(sc, _ep_row_pspec(sc.ndim)),
+            ))
         return QuantizedTensor(
             self._put(pk, _pspec_for(key, pk.ndim, True, "packed")),
             self._put(sc, _pspec_for(key, sc.ndim, True, "scales")),
         )
 
-    def _col_q40(self, packed: np.ndarray, scales: np.ndarray):
-        """Host-side block-aligned col repack -> TpColWeight stack, placed
-        shard-per-device (no transient full copy on one device — the repack
-        the engine-side path cannot avoid, parallel/sharding.py)."""
+    def _col_q40(self, packed: np.ndarray, scales: np.ndarray,
+                 ep: bool = False):
+        """Host-side block-aligned col repack -> TpColWeight stack (or
+        EpColWeight for ep-placed expert stacks), placed shard-per-device
+        (no transient full copy on one device — the repack the engine-side
+        path cannot avoid, parallel/sharding.py)."""
+        from ..parallel.ep_moe import EpColWeight
         from ..parallel.tp_q80 import TpColWeight
 
         tp = self.tp
@@ -131,16 +158,25 @@ class _Placer:
         sc = np.moveaxis(scales.reshape(*lead, tp, nb // tp), -2, 0)
         pk_dev, sc_dev = QuantizedTensor.host_layout(
             np.ascontiguousarray(sc), np.ascontiguousarray(pk))
-        return TpColWeight(QuantizedTensor(
-            self._put(pk_dev, _col_stack_pspec(pk_dev.ndim)),
-            self._put(sc_dev, _col_stack_pspec(sc_dev.ndim)),
+        wrap = EpColWeight if ep else TpColWeight
+        return wrap(QuantizedTensor(
+            self._put(pk_dev, _col_stack_pspec(pk_dev.ndim, ep=ep)),
+            self._put(sc_dev, _col_stack_pspec(sc_dev.ndim, ep=ep)),
         ))
 
 
-def _col_stack_pspec(ndim: int):
+def _col_stack_pspec(ndim: int, ep: bool = False):
     from jax.sharding import PartitionSpec as P
 
+    if ep:  # (tp, E, d, ...): tp stack on tp, experts on ep
+        return P(TP_AXIS, EP_AXIS, *([None] * (ndim - 2)))
     return P(TP_AXIS, *([None] * (ndim - 1)))
+
+
+def _ep_row_pspec(ndim: int):
+    from jax.sharding import PartitionSpec as P
+
+    return P(EP_AXIS, TP_AXIS, *([None] * (ndim - 2)))
 
 
 def _fuse_group(key: str) -> str | None:
@@ -184,9 +220,10 @@ def load_params_streamed(
     """
     assert mode in ("dense", "q40")
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+    ep = mesh.shape.get(EP_AXIS, 1) if mesh is not None else 1
     if fuse is None:
         fuse = tp == 1
-    placer = _Placer(mesh, mode, dtype, tp, q80_collectives)
+    placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep)
 
     p: dict = {"layers": [dict() for _ in range(spec.n_layers)]}
     pending: dict[str, list[HostTensor]] = {}

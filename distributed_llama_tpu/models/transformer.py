@@ -189,6 +189,32 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
     top_p, top_idx = lax.top_k(probs, k_active)           # (B, T, K)
     weights = top_p / top_p.sum(axis=-1, keepdims=True)   # ref: grok1-tasks.cpp:99-114
 
+    def scatter_weights():
+        # (B, T, E) dense scatter of the normalized top-k weights (0 for
+        # inactive experts) — shared by the ep and dense-prefill paths
+        return jnp.zeros_like(probs).at[
+            jnp.arange(b)[:, None, None],
+            jnp.arange(t)[None, :, None],
+            top_idx,
+        ].set(weights)
+
+    from ..parallel.ep_moe import EpRowWeight
+
+    if isinstance(lw["moe_up"], EpRowWeight):
+        # expert-parallel placement (ep mesh axis): each ep shard computes
+        # only its local experts, masked by the scattered routing weights
+        from ..parallel.ep_moe import ep_moe_ffn
+
+        e_weights = scatter_weights()
+        return ep_moe_ffn(
+            xb, e_weights, lw, cfg["tp_mesh"],
+            act_fn=lambda g: apply_hidden_act(g, spec.hidden_act),
+            compute_dtype=cfg["compute_dtype"],
+            use_pallas=cfg.get("use_pallas", False),
+            interpret=cfg.get("pallas_interpret", False),
+            reduce=cfg.get("tp_reduce", "exact"),
+        ).astype(xb.dtype)
+
     def expert_apply(w_up, w_gate, w_down, x_tok):
         gate = matmul(x_tok, w_gate, **cfg)
         up = matmul(x_tok, w_up, **cfg)
@@ -212,11 +238,7 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
         return acc
 
     # prefill: dense all-expert compute, mask by routing weights
-    e_weights = jnp.zeros_like(probs).at[
-        jnp.arange(b)[:, None, None],
-        jnp.arange(t)[None, :, None],
-        top_idx,
-    ].set(weights)  # (B, T, E) scatter of normalized weights
+    e_weights = scatter_weights()
 
     def all_experts(e, acc):
         up_e = _take_expert(lw["moe_up"], e)

@@ -6,6 +6,8 @@ with named axes:
 
   dp — data parallel (batch; net-new vs the reference, which is batch=1)
   sp — sequence/context parallel (ring attention axis)
+  ep — expert parallel (MoE experts placed across devices; net-new — the
+       reference only TP-slices every expert, ref: grok1-tasks.cpp:56-126)
   tp — tensor parallel (the reference's nSlices axis)
 
 Multi-host TPU slices work transparently: `jax.devices()` spans hosts and
@@ -21,18 +23,21 @@ from jax.sharding import Mesh
 
 DP_AXIS = "dp"
 SP_AXIS = "sp"
+EP_AXIS = "ep"
 TP_AXIS = "tp"
 
 
-def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1,
+def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1, ep: int = 1,
               devices=None) -> Mesh:
-    """Build a (dp, sp, tp) mesh. tp defaults to all remaining devices."""
+    """Build a (dp, sp, ep, tp) mesh. tp defaults to all remaining devices.
+    ep neighbors tp so the MoE partial-sum psum over (ep, tp) rides the
+    innermost (fastest) ICI dimension."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if tp is None:
-        assert n % (dp * sp) == 0, (n, dp, sp)
-        tp = n // (dp * sp)
-    need = dp * sp * tp
-    assert need <= n, f"mesh {dp}x{sp}x{tp} needs {need} devices, have {n}"
-    arr = np.array(devices[:need]).reshape(dp, sp, tp)
-    return Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))
+        assert n % (dp * sp * ep) == 0, (n, dp, sp, ep)
+        tp = n // (dp * sp * ep)
+    need = dp * sp * ep * tp
+    assert need <= n, f"mesh {dp}x{sp}x{ep}x{tp} needs {need} devices, have {n}"
+    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
+    return Mesh(arr, (DP_AXIS, SP_AXIS, EP_AXIS, TP_AXIS))

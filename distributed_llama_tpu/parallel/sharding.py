@@ -75,8 +75,12 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
 
 
 def _leaf_spec(name: str, w):
+    from .ep_moe import EpColWeight, EpRowWeight, ep_pspec
     from .tp_q80 import TpColWeight, TpRowWeight, tp_col_pspec, tp_row_pspec
 
+    if isinstance(w, (EpRowWeight, EpColWeight)):
+        # expert-parallel mode: expert axis on ep (parallel/ep_moe.py)
+        return ep_pspec(w)
     if isinstance(w, TpColWeight):
         # q80-collective mode: col weights are pre-stacked (tp, ..., d, n/tp)
         return tp_col_pspec(w)
@@ -146,7 +150,10 @@ def repack_col_weights(params: dict, tp: int) -> dict:
     from .tp_q80 import TpColWeight, repack_col_tp
 
     def repack(v):
-        if isinstance(v, TpColWeight):  # already repacked (streamed loader)
+        from .ep_moe import EpColWeight
+
+        # already repacked (streamed loader) or owned by the ep path
+        if isinstance(v, (TpColWeight, EpColWeight)):
             return v
         return repack_col_tp(v, tp)
 
@@ -191,9 +198,10 @@ def shard_params(params: dict, mesh) -> dict:
         return jax.device_put(w, NamedSharding(mesh, s))
 
     def put_entry(w, sp):
+        from .ep_moe import EpColWeight, EpRowWeight
         from .tp_q80 import TpColWeight, TpRowWeight
 
-        if isinstance(w, (TpColWeight, TpRowWeight)):
+        if isinstance(w, (TpColWeight, TpRowWeight, EpColWeight, EpRowWeight)):
             return type(w)(put_entry(w.w, sp.w))
         if isinstance(w, QuantizedTensor):
             return QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))

@@ -99,6 +99,17 @@ class Engine:
         self.tp_reduce = "q80" if self.q80_collectives else "exact"
         if mesh_kernels:
             self._tp_mesh = mesh
+        # ep > 1: MoE experts are PLACED across the ep axis (E/ep experts per
+        # device — net-new vs the reference's TP-only expert slicing); the
+        # MoE block always runs the shard_map path then (parallel/ep_moe.py)
+        from ..parallel.mesh import EP_AXIS
+
+        ep = mesh.shape.get(EP_AXIS, 1) if mesh is not None else 1
+        if ep > 1:
+            assert spec.is_moe, "--ep requires a MoE model (experts to place)"
+            assert spec.n_experts % ep == 0, (
+                f"ep={ep} must divide n_experts={spec.n_experts}")
+            self._tp_mesh = mesh
 
         if tp == 1:
             # single-shard fast path: fused QKV / w1|w3 kernel calls
@@ -109,6 +120,15 @@ class Engine:
             q40 = any(isinstance(v, QuantizedTensor)
                       for lw in params["layers"] for v in lw.values())
             check_tp_constraints(spec, tp, q40=q40)
+            if ep > 1:
+                from ..parallel.ep_moe import EpRowWeight, repack_moe_ep
+
+                params = dict(params)
+                params["layers"] = [
+                    lw if isinstance(lw.get("moe_up"), EpRowWeight)
+                    else repack_moe_ep(lw, tp)
+                    for lw in params["layers"]
+                ]
             if self.q80_collectives or (mesh_kernels and tp > 1 and q40):
                 from ..parallel.sharding import repack_col_weights
 
@@ -170,16 +190,30 @@ class Engine:
             batch=self.batch)
 
     def measure_transfer_ms(self) -> float:
-        """Measured per-token transfer estimate: times one dim-sized
-        all-reduce on the mesh and scales by the per-layer reduce count (the
-        reference's T column, measured not modeled)."""
+        """Measured per-token transfer estimate: times dim-sized all-reduces
+        on the mesh and scales by the per-layer reduce count (the reference's
+        T column, measured not modeled). Mirrors the collective structure
+        netstats.estimate_decode_wire models: per-layer tp reduces, plus the
+        single (ep, tp)-group MoE reduce when experts are ep-placed."""
         from .netstats import measure_allreduce_ms
 
-        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+        if self.mesh is None:
             return 0.0
-        per = measure_allreduce_ms(self.mesh, self.spec.dim)
-        reduces = (1 + self.spec.n_active_experts) if self.spec.is_moe else 2
-        return per * reduces * self.spec.n_layers
+        tp = self.mesh.shape.get("tp", 1)
+        ep = self.mesh.shape.get("ep", 1)
+        total = 0.0
+        if self.spec.is_moe and ep > 1:
+            if tp > 1:  # attention wo reduce stays tp-only
+                total += (measure_allreduce_ms(self.mesh, self.spec.dim)
+                          * self.spec.n_layers)
+            total += (measure_allreduce_ms(self.mesh, self.spec.dim,
+                                           axes=("ep", "tp"))
+                      * self.spec.n_layers)
+        elif tp > 1:
+            per = measure_allreduce_ms(self.mesh, self.spec.dim)
+            reduces = (1 + self.spec.n_active_experts) if self.spec.is_moe else 2
+            total += per * reduces * self.spec.n_layers
+        return total
 
     # -- compiled steps ---------------------------------------------------
 

@@ -67,18 +67,34 @@ def estimate_decode_wire(
     tp = mesh.shape.get("tp", 1)
     sp = mesh.shape.get("sp", 1)
     dp = mesh.shape.get("dp", 1)
+    ep = mesh.shape.get("ep", 1)
     b_local = max(1, batch // dp)
     bd: dict[str, float] = {}
 
+    val_bytes = 1.0625 if q80 else act_bytes  # int8 + f16/32-block scale
     if tp > 1:
-        reduces_per_layer = (1 + spec.n_active_experts) if spec.is_moe else 2
-        val_bytes = 1.0625 if q80 else act_bytes  # int8 + f16/32-block scale
+        # with ep the MoE expert-sum reduce moves out of the tp column (see
+        # ep_moe_reduce below); only the attention wo reduce stays per-layer
+        if spec.is_moe:
+            reduces_per_layer = 1 if ep > 1 else 1 + spec.n_active_experts
+        else:
+            reduces_per_layer = 2
         per_reduce = spec.dim * b_local * val_bytes
         layer_fn = _ar  # both the f32 all-reduce and the 2-shot q80
         # exchange move 2*(n-1)/n * payload per device
         bd["tp_partial_sums"] = (spec.n_layers * reduces_per_layer
                                  * layer_fn(tp, per_reduce))
         bd["tp_logits_gather"] = _ag(tp, spec.vocab_size * b_local * 4)
+    if ep > 1:
+        # one MoE output reduce per layer (parallel/ep_moe.py): exact mode is
+        # a single all-reduce over the ep*tp group; q80 mode is a quantized
+        # 2-shot over tp followed by an exact f32 psum over ep
+        per = spec.dim * b_local
+        if q80 and tp > 1:
+            moe = _ar(tp, per * val_bytes) + _ar(ep, per * act_bytes)
+        else:
+            moe = _ar(ep * tp, per * act_bytes)
+        bd["ep_moe_reduce"] = spec.n_layers * moe
     if sp > 1:
         stat = spec.n_heads * spec.head_size + 2 * spec.n_heads  # acc + m + l
         bd["sp_attn_merge"] = spec.n_layers * _ar(sp, stat * b_local * 4)
@@ -88,30 +104,35 @@ def estimate_decode_wire(
                         {k: v / 1024.0 for k, v in bd.items()})
 
 
-def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16) -> float:
-    """Time one f32 all-reduce of `payload_elems` on the mesh's tp axis —
-    the measured analogue of the reference's per-token T column. Returns ms
-    per all-reduce (amortized over iters; sync via device->host transfer,
-    the only true sync on tunneled TPU platforms)."""
+def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
+                         axes: tuple = ("tp",)) -> float:
+    """Time one f32 all-reduce of `payload_elems` over the given mesh axes
+    (jointly — e.g. ("ep", "tp") for the MoE group reduce) — the measured
+    analogue of the reference's per-token T column. Returns ms per
+    all-reduce (amortized over iters; sync via device->host transfer, the
+    only true sync on tunneled TPU platforms)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    tp = mesh.shape.get("tp", 1)
-    if tp <= 1:
+    axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    if n <= 1:
         return 0.0
 
     @jax.jit
     def run(x):
         def body(v):
             for _ in range(iters):
-                v = jax.lax.psum(v, "tp") * (1.0 / tp)
+                v = jax.lax.psum(v, axes) * (1.0 / n)
             return v
-        return shard_map(body, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
-                         check_vma=False)(x)
+        return shard_map(body, mesh=mesh, in_specs=P(axes),
+                         out_specs=P(axes), check_vma=False)(x)
 
-    x = jnp.ones((tp, payload_elems), jnp.float32)
+    x = jnp.ones((n, payload_elems), jnp.float32)
     np.asarray(run(x))  # compile + warm
     t0 = time.perf_counter()
     np.asarray(run(x))

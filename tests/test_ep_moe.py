@@ -1,0 +1,147 @@
+"""Expert-parallel MoE tests on the virtual 8-device CPU mesh.
+
+Placement-EP is net-new vs the reference, which only TP-slices every expert
+(ref: src/grok1-tasks.cpp:56-143; SURVEY.md §2.5). The invariants: (1) the
+ep-sharded engine reproduces the single-device greedy token stream for both
+MoE archs, (2) each device actually stores only E/ep experts (the memory
+claim), (3) ep composes with tp, the Pallas kernels, and the q80 reduce.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models import ArchType
+from distributed_llama_tpu.models.params import load_params
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.parallel.ep_moe import EpColWeight, EpRowWeight
+from distributed_llama_tpu.runtime import Engine
+from distributed_llama_tpu.sampler import Sampler
+
+from test_model_forward import make_spec, dense_weights
+
+PROMPT = [2, 5, 8, 1]
+
+
+def greedy():
+    return Sampler(256, temperature=0.0, topp=0.9, seed=1)
+
+
+def moe_params(arch, mode="q40", seed=11):
+    spec = make_spec(arch, dim=128, n_heads=8, n_kv_heads=4, hidden_dim=256)
+    host, _ = dense_weights(spec, seed=seed)
+    return spec, load_params(spec, host, mode=mode, dtype=jnp.float32)
+
+
+def baseline_tokens(spec, params, n=6):
+    eng = Engine(spec, params, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, use_pallas=False)
+    return eng.generate(PROMPT, max_tokens=n, sampler=greedy()).tokens
+
+
+@pytest.mark.parametrize("arch", [ArchType.MIXTRAL, ArchType.GROK1])
+@pytest.mark.parametrize("ep,tp", [(2, 1), (4, 2)])
+def test_ep_decode_matches_single_device(arch, ep, tp):
+    spec, params = moe_params(arch)
+    want = baseline_tokens(spec, params)
+    eng = Engine(spec, params, make_mesh(ep=ep, tp=tp, dp=1),
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                 use_pallas=False)
+    got = eng.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+    assert got == want, (got, want)
+
+
+def test_ep_expert_placement_shards_memory():
+    """Each device must hold only n_experts/ep experts' bytes — the point of
+    placement-EP (per-device expert memory = total/ep)."""
+    spec, params = moe_params(ArchType.MIXTRAL)
+    ep = 4
+    eng = Engine(spec, params, make_mesh(ep=ep, tp=2, dp=1),
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                 use_pallas=False)
+    lw = eng.params["layers"][0]
+    assert isinstance(lw["moe_up"], EpRowWeight)
+    assert isinstance(lw["moe_down"], EpColWeight)
+    up = lw["moe_up"].w.packed
+    assert up.sharding.spec[0] == "ep" and up.sharding.spec[1] == "tp"
+    # local shard = (E/ep) experts x (d/tp) rows
+    shard_shape = up.sharding.shard_shape(up.shape)
+    assert shard_shape[0] == spec.n_experts // ep
+    assert shard_shape[1] == up.shape[1] // 2
+    down = lw["moe_down"].w.packed  # (tp, E, d, ...) stack
+    assert down.sharding.spec[0] == "tp" and down.sharding.spec[1] == "ep"
+
+
+def test_ep_with_pallas_and_q80():
+    """ep + tp + Pallas kernels + quantized tp reduce compose; logits stay
+    within block-quant tolerance of the exact ep path."""
+    spec, params = moe_params(ArchType.MIXTRAL)
+    mesh = make_mesh(ep=2, tp=2, dp=1)
+    exact = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                   cache_dtype=jnp.float32, use_pallas=True,
+                   pallas_interpret=True)
+    q80 = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, use_pallas=True,
+                 pallas_interpret=True, activation_q80=True,
+                 q80_collectives=True)
+    tok = np.asarray([PROMPT], np.int32)
+    le = np.asarray(exact.step(tok, 0))
+    lq = np.asarray(q80.step(tok, 0))
+    assert np.isfinite(le).all() and np.isfinite(lq).all()
+    np.testing.assert_allclose(lq, le, atol=0.05, rtol=0)
+    # and the exact+pallas ep path still matches the greedy baseline
+    want = baseline_tokens(spec, params)
+    exact.reset()
+    got = exact.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+    assert got == want, (got, want)
+
+
+@pytest.mark.parametrize("q80", [False, True])
+def test_ep_streamed_loader_places_experts(tmp_path, q80):
+    """The streamed loader must place E/ep experts per device directly (no
+    full-E transient) and its output must feed the engine unchanged — incl.
+    the q80 mode where col weights arrive pre-repacked as TpColWeight (a
+    crash regression: repack_moe_ep must re-mark, not re-repack)."""
+    import dataclasses
+
+    from distributed_llama_tpu.io.model_file import write_model
+    from distributed_llama_tpu.models.loader import load_params_streamed
+    from distributed_llama_tpu.quants.types import FloatType
+
+    spec, _ = moe_params(ArchType.MIXTRAL)
+    host, _ = dense_weights(spec, seed=11)
+    q40_spec = dataclasses.replace(spec, weights_float_type=FloatType.Q40)
+    mpath = str(tmp_path / "tiny_moe.m")
+    write_model(mpath, q40_spec, {n: t.to_f32() for n, t in host.items()})
+
+    mesh = make_mesh(ep=2, tp=2, dp=1)
+    params, _ = load_params_streamed(q40_spec, mpath, mesh, mode="q40",
+                                     dtype=jnp.float32, q80_collectives=q80)
+    lw = params["layers"][0]
+    assert isinstance(lw["moe_up"], EpRowWeight)
+    assert isinstance(lw["moe_down"], EpColWeight)
+    up = lw["moe_up"].w.packed
+    assert up.sharding.spec[0] == "ep"
+    assert up.sharding.shard_shape(up.shape)[0] == spec.n_experts // 2
+
+    eng = Engine(spec, params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, use_pallas=False,
+                 activation_q80=q80, q80_collectives=q80)
+    if q80:
+        logits = eng.step(np.asarray([PROMPT], np.int32), 0)
+        assert np.isfinite(np.asarray(logits)).all()
+    else:
+        base = load_params(spec, host, mode="q40", dtype=jnp.float32)
+        want = baseline_tokens(spec, base)
+        got = eng.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+        assert got == want, (got, want)
+
+
+def test_ep_requires_moe():
+    spec = make_spec(ArchType.LLAMA, dim=128, n_heads=8, n_kv_heads=4,
+                     hidden_dim=256)
+    host, _ = dense_weights(spec, seed=3)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    with pytest.raises(AssertionError, match="MoE"):
+        Engine(spec, params, make_mesh(ep=2, tp=1, dp=1),
+               compute_dtype=jnp.float32, cache_dtype=jnp.float32)
