@@ -64,6 +64,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel mesh size (MoE models: each device "
                         "holds n_experts/ep experts)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel mesh size (each device holds "
+                        "n_layers/pp layers and their KV cache)")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--cache-dtype", default="bf16", choices=["bf16", "f32"])
@@ -107,9 +110,10 @@ def build_engine(args):
     kdt = jnp.bfloat16 if args.cache_dtype == "bf16" else jnp.float32
 
     mesh = None
-    if args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1:
+    if args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1 or args.pp > 1:
         from ..parallel.mesh import make_mesh
-        mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp, ep=args.ep)
+        mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp, ep=args.ep,
+                         pp=args.pp)
 
     # streamed sharded load: one tensor resident at a time, each shard
     # placed straight onto its device (ref weight push: transformer.cpp:562-621)

@@ -60,21 +60,34 @@ class KVCache(NamedTuple):
 
     @classmethod
     def create(cls, spec: ModelSpec, batch: int, seq_len: int | None = None,
-               dtype=jnp.float32) -> "KVCache":
+               dtype=jnp.float32, pp: int = 1) -> "KVCache":
+        """pp > 1: stage-stacked layout — n_layers/pp leaves of
+        (pp, B, KVH, S, hs), the stage axis sharded over pp so each device
+        stores only its own layers' cache (parallel/pp.py)."""
         s = seq_len or spec.seq_len
         shape = (batch, spec.n_kv_heads, s, spec.head_size)
+        n = spec.n_layers
+        if pp > 1:
+            assert n % pp == 0, (n, pp)
+            shape = (pp,) + shape
+            n = n // pp
         return cls(
-            tuple(jnp.zeros(shape, dtype) for _ in range(spec.n_layers)),
-            tuple(jnp.zeros(shape, dtype) for _ in range(spec.n_layers)),
+            tuple(jnp.zeros(shape, dtype) for _ in range(n)),
+            tuple(jnp.zeros(shape, dtype) for _ in range(n)),
         )
 
 
 def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
-                     sp_mesh=None, sp_cache_mesh=None, per_row_pos=False):
+                     sp_mesh=None, sp_cache_mesh=None, per_row_pos=False,
+                     write_gate=None):
     """Norm -> QKV -> RoPE -> cache update -> attention -> output proj.
 
     Returns (attn_out, new_k_cache, new_v_cache). attn_out is the wo
     projection NOT yet added to the residual (archs differ there).
+    write_gate: optional traced bool — when False the cache update re-writes
+    the existing values (pipeline parallelism runs every stage's layers on
+    every device each iteration, but only the live stage may write its
+    cache — parallel/pp.py).
     """
     b, t, d = x.shape
     h, kvh, hs = spec.n_heads, spec.n_kv_heads, spec.head_size
@@ -101,14 +114,30 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
         # batched generation: each sequence writes at its own position
         # (net-new vs the reference's batch=1 — SURVEY.md §2.5 DP row)
         bidx = jnp.arange(b, dtype=jnp.int32)[:, None]
-        k_cache = k_cache.at[bidx, :, q_pos].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[bidx, :, q_pos].set(v.astype(v_cache.dtype))
+        if write_gate is not None:
+            # gate by pushing the write index out of bounds when it is not
+            # this stage's turn — scatter drops OOB updates (cheaper than a
+            # read-modify-write, and XLA's partitioner handles the scatter
+            # where it miscompiles the equivalent gather under manual pp)
+            q_write = jnp.where(write_gate, q_pos, k_cache.shape[2])
+        else:
+            q_write = q_pos
+        k_cache = k_cache.at[bidx, :, q_write].set(
+            k.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[bidx, :, q_write].set(
+            v.astype(v_cache.dtype), mode="drop")
     else:
         pos0 = q_pos[:, 0]
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos0[0], 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos0[0], 0))
+        k_w = k.transpose(0, 2, 1, 3).astype(k_cache.dtype)
+        v_w = v.transpose(0, 2, 1, 3).astype(v_cache.dtype)
+        if write_gate is not None:
+            start = (0, 0, pos0[0], 0)
+            k_w = jnp.where(write_gate, k_w,
+                            lax.dynamic_slice(k_cache, start, k_w.shape))
+            v_w = jnp.where(write_gate, v_w,
+                            lax.dynamic_slice(v_cache, start, v_w.shape))
+        k_cache = lax.dynamic_update_slice(k_cache, k_w, (0, 0, pos0[0], 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v_w, (0, 0, pos0[0], 0))
     if sp_cache_mesh is not None:
         # keep the cache sp-sharded through the functional update: during ring
         # prefill the T-sharded K/V reshards into the S-sharded cache (one
@@ -271,10 +300,11 @@ def _take_expert(w, e):
 
 
 def _layer(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg, sp_mesh=None,
-           sp_cache_mesh=None, per_row_pos=False):
+           sp_cache_mesh=None, per_row_pos=False, write_gate=None):
     attn_out, k_cache, v_cache = _attention_block(
         x, lw, spec, k_cache, v_cache, q_pos, cfg, sp_mesh=sp_mesh,
-        sp_cache_mesh=sp_cache_mesh, per_row_pos=per_row_pos)
+        sp_cache_mesh=sp_cache_mesh, per_row_pos=per_row_pos,
+        write_gate=write_gate)
 
     if spec.arch == ArchType.GROK1:
         # post-attention norm BEFORE residual add (ref: grok1-tasks.cpp:16-41)
@@ -312,6 +342,7 @@ def forward(
     tp_reduce: str = "exact",
     pallas_interpret: bool = False,
     sp_cache_mesh=None,
+    pp_mesh=None,
     logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
@@ -327,6 +358,9 @@ def forward(
     sp_cache_mesh: a Mesh whose sp axis shards the KV cache's sequence dim
     (cache_pspec(sp=True)) — cache writes keep that sharding and attention
     reads it chunk-wise (parallel/ring_attention.py:sp_cache_attention).
+    pp_mesh: a Mesh whose pp axis places the layers in stages — params
+    "layers" must be stage-stacked (parallel/pp.py:stack_stages) and the
+    cache stage-stacked (KVCache.create(pp=...)).
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
                use_pallas=use_pallas, tp_mesh=tp_mesh, tp_reduce=tp_reduce,
@@ -344,16 +378,26 @@ def forward(
         q_pos = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
         q_pos = jnp.broadcast_to(q_pos, (b, t))
 
-    # statically unrolled layer loop (see module docstring for why not scan)
-    k_all: list = []
-    v_all: list = []
-    for l in range(spec.n_layers):
-        x, k_new, v_new = _layer(x, params["layers"][l], spec,
-                                 cache.k[l], cache.v[l], q_pos, cfg,
-                                 sp_mesh=sp_mesh, sp_cache_mesh=sp_cache_mesh,
-                                 per_row_pos=per_row_pos)
-        k_all.append(k_new)
-        v_all.append(v_new)
+    if pp_mesh is not None:
+        # layers placed in stages over pp (parallel/pp.py)
+        from ..parallel.pp import pp_layers
+
+        x, k_all, v_all = pp_layers(x, params["layers"], spec, cache, q_pos,
+                                    cfg, pp_mesh, per_row_pos=per_row_pos)
+        k_all, v_all = list(k_all), list(v_all)
+    else:
+        # statically unrolled layer loop (see module docstring for why not
+        # scan)
+        k_all = []
+        v_all = []
+        for l in range(spec.n_layers):
+            x, k_new, v_new = _layer(x, params["layers"][l], spec,
+                                     cache.k[l], cache.v[l], q_pos, cfg,
+                                     sp_mesh=sp_mesh,
+                                     sp_cache_mesh=sp_cache_mesh,
+                                     per_row_pos=per_row_pos)
+            k_all.append(k_new)
+            v_all.append(v_new)
 
     x = rmsnorm(x, params["rms_final"])  # ref: llama2-tasks.cpp:222-234
     if not logits_for_all:

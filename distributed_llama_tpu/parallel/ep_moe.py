@@ -26,8 +26,6 @@ dense FFN (parallel/tp_q80.py layouts).
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,39 +36,24 @@ from ..quants.jax_codec import QuantizedTensor
 from .collectives import q80_psum_2shot
 from .mesh import EP_AXIS, TP_AXIS
 from .tp_q80 import TpColWeight, _batch_axes, repack_col_tp
+from .wrappers import WeightWrapper, weight_marker
 
 
-@jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
-class EpRowWeight:
+@weight_marker
+class EpRowWeight(WeightWrapper):
     """A stacked (E, d, n) MoE row weight (moe_up / moe_gate): experts on
     ep, output rows on tp. No repacking — both axes shard contiguously."""
 
     w: QuantizedTensor | jax.Array
 
-    def tree_flatten(self):
-        return (self.w,), None
 
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
-
-
-@jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
-class EpColWeight:
+@weight_marker
+class EpColWeight(WeightWrapper):
     """A stacked MoE col weight (moe_down) in TpColWeight layout
     (tp, E, d, n/tp): tp stack on tp, experts on ep. The tp restacking keeps
     Q40 blocks contiguous per shard (see tp_q80.repack_col_tp)."""
 
     w: QuantizedTensor | jax.Array
-
-    def tree_flatten(self):
-        return (self.w,), None
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
 
 
 def repack_moe_ep(lw: dict, tp: int) -> dict:

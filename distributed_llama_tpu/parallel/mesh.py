@@ -8,6 +8,8 @@ with named axes:
   sp — sequence/context parallel (ring attention axis)
   ep — expert parallel (MoE experts placed across devices; net-new — the
        reference only TP-slices every expert, ref: grok1-tasks.cpp:56-126)
+  pp — pipeline parallel (layers placed in stages across devices; net-new —
+       every reference node runs every layer, ref: llama2-tasks.cpp:214-220)
   tp — tensor parallel (the reference's nSlices axis)
 
 Multi-host TPU slices work transparently: `jax.devices()` spans hosts and
@@ -24,20 +26,22 @@ from jax.sharding import Mesh
 DP_AXIS = "dp"
 SP_AXIS = "sp"
 EP_AXIS = "ep"
+PP_AXIS = "pp"
 TP_AXIS = "tp"
 
 
 def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1, ep: int = 1,
-              devices=None) -> Mesh:
-    """Build a (dp, sp, ep, tp) mesh. tp defaults to all remaining devices.
-    ep neighbors tp so the MoE partial-sum psum over (ep, tp) rides the
-    innermost (fastest) ICI dimension."""
+              pp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, sp, ep, pp, tp) mesh. tp defaults to all remaining
+    devices. ep/pp neighbor tp so the per-layer reduces ride the innermost
+    (fastest) ICI dimensions; pp's stage hop is the cheapest collective."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if tp is None:
-        assert n % (dp * sp * ep) == 0, (n, dp, sp, ep)
-        tp = n // (dp * sp * ep)
-    need = dp * sp * ep * tp
-    assert need <= n, f"mesh {dp}x{sp}x{ep}x{tp} needs {need} devices, have {n}"
-    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
-    return Mesh(arr, (DP_AXIS, SP_AXIS, EP_AXIS, TP_AXIS))
+        assert n % (dp * sp * ep * pp) == 0, (n, dp, sp, ep, pp)
+        tp = n // (dp * sp * ep * pp)
+    need = dp * sp * ep * pp * tp
+    assert need <= n, (
+        f"mesh {dp}x{sp}x{ep}x{pp}x{tp} needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(dp, sp, ep, pp, tp)
+    return Mesh(arr, (DP_AXIS, SP_AXIS, EP_AXIS, PP_AXIS, TP_AXIS))

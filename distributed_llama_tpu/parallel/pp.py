@@ -1,0 +1,142 @@
+"""Pipeline parallelism: transformer layers placed in stages (shard_map).
+
+Net-new vs the reference, where every node runs every layer in lock-step
+(ref: src/llama2-tasks.cpp:214-220; SURVEY.md §2.5 marks PP absent). The
+mesh's `pp` axis shards the LAYER axis: device p stores only layers
+[p*L/pp, (p+1)*L/pp) — weights AND their KV cache — which is the
+model-size axis orthogonal to tp (pp*tp devices fit a model pp*tp times
+larger than one device, with tp bounded by n_kv_heads).
+
+Execution model (single in-flight segment — decode and chunked prefill):
+the layer pytree is restacked so slot j's leaves carry a leading (pp,)
+stage axis sharded over pp. Inside a PARTIAL-MANUAL shard_map (manual over
+pp and dp; tp stays auto so GSPMD keeps partitioning the per-layer matmuls
+and inserting the tp all-reduces), every stage s runs in sequence:
+
+    for s in range(pp):                      # static
+        y = my_local_layers(x)               # all devices compute
+        x = psum(where(stage_index == s, y, 0), pp)   # live stage broadcasts
+
+All devices compute every stage iteration on whatever x they hold, but
+only stage s's result survives iteration s — SPMD-uniform control flow,
+wall-clock identical to the sequential layer loop (plus pp small dim-sized
+broadcasts per segment). KV-cache writes are gated so a device's cache
+slots are only written on its own stage's iteration (`write_gate` in
+models/transformer._attention_block); off-turn iterations re-write the
+existing values.
+
+GPipe-style microbatch overlap across dp is a possible follow-up; this
+path's purpose is the memory/placement axis, matching the reference's
+inference-latency orientation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..quants.jax_codec import QuantizedTensor
+from .mesh import PP_AXIS
+from .wrappers import WeightWrapper, weight_marker
+
+
+@weight_marker
+class PpWeight(WeightWrapper):
+    """A layer weight restacked with a leading (pp,) stage axis: element s
+    of the stack is stage s's layer for this slot. Sharded P('pp', <the
+    weight's usual tp split>) — see sharding._leaf_spec."""
+
+    w: QuantizedTensor | jax.Array
+
+
+def stack_stages(params: dict, pp: int) -> dict:
+    """layers[L] -> layers[L/pp] slot dicts whose leaves stack the pp
+    stages' weights: new_layers[j] leaf = stack(layers[s*L/pp + j] for s).
+    Leaves become PpWeight so sharding/spec code routes them."""
+    layers = params["layers"]
+    n_l = len(layers)
+    assert n_l % pp == 0, (n_l, pp)
+    n_slot = n_l // pp
+
+    def stack(leaves):
+        if isinstance(leaves[0], PpWeight):  # already stacked
+            return leaves[0]
+        if isinstance(leaves[0], QuantizedTensor):
+            return PpWeight(QuantizedTensor(
+                jnp.stack([w.packed for w in leaves]),
+                jnp.stack([w.scales for w in leaves])))
+        return PpWeight(jnp.stack(leaves))
+
+    out = dict(params)
+    out["layers"] = [
+        {k: stack([layers[s * n_slot + j][k] for s in range(pp)])
+         for k in layers[j]}
+        for j in range(n_slot)
+    ]
+    return out
+
+
+def _unwrap0(w):
+    """Strip the local (1,)-length stage axis off a PpWeight leaf inside the
+    shard_map body, yielding this device's plain layer weight."""
+    if isinstance(w.w, QuantizedTensor):
+        return QuantizedTensor(w.w.packed[0], w.w.scales[0])
+    return w.w[0]
+
+
+def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
+    """Run all L layers across the pp stages; returns (x, k_all, v_all).
+
+    x: (B, T, dim) replicated over pp (dp/tp sharding rides the auto axes).
+    layers: L/pp slot dicts of PpWeight leaves. cache: KVCache whose leaves
+    are (pp, B, KVH, S, hs), sharded over pp on the stage axis.
+    """
+    from jax import shard_map
+
+    from ..models.transformer import _layer
+    from .mesh import DP_AXIS
+
+    pp = mesh.shape[PP_AXIS]
+    n_slot = len(layers)
+    # inside the manual region the layer math runs the plain GSPMD path:
+    # tp is the only auto axis there (dp is manual — XLA's partitioner
+    # miscompiles the per-row cache scatter when the batch dim is an auto
+    # subgroup axis), and the explicit shard_map kernel paths (tp_q80.py)
+    # cannot nest inside it
+    inner_cfg = {**cfg, "tp_mesh": None, "use_pallas": False}
+    dp = mesh.shape.get(DP_AXIS, 1)
+    b = x.shape[0]
+    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
+
+    def body(x_l, q_pos_l, layers_l, k_l, v_l):
+        p = lax.axis_index(PP_AXIS)
+        k_l = list(k_l)
+        v_l = list(v_l)
+        for s in range(pp):
+            y = x_l
+            gate = (p == s)
+            for j in range(n_slot):
+                lw = {k: _unwrap0(w) for k, w in layers_l[j].items()}
+                y, k_new, v_new = _layer(
+                    y, lw, spec, k_l[j][0], v_l[j][0], q_pos_l, inner_cfg,
+                    per_row_pos=per_row_pos, write_gate=gate)
+                k_l[j] = k_new[None]
+                v_l[j] = v_new[None]
+            x_l = lax.psum(jnp.where(gate, y, jnp.zeros_like(y)), PP_AXIS)
+        return x_l, tuple(k_l), tuple(v_l)
+
+    def wspec(w):
+        if isinstance(w.w, QuantizedTensor):
+            return PpWeight(QuantizedTensor(P(PP_AXIS), P(PP_AXIS)))
+        return PpWeight(P(PP_AXIS))
+
+    layer_specs = [{k: wspec(w) for k, w in lw.items()} for lw in layers]
+    cache_spec = (P(PP_AXIS, dp_ax),) * n_slot
+    x_spec = P(dp_ax)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(x_spec, x_spec, layer_specs, cache_spec, cache_spec),
+        out_specs=(x_spec, cache_spec, cache_spec),
+        axis_names={PP_AXIS, DP_AXIS}, check_vma=False)
+    return fn(x, q_pos, layers, cache.k, cache.v)

@@ -76,8 +76,21 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
 
 def _leaf_spec(name: str, w):
     from .ep_moe import EpColWeight, EpRowWeight, ep_pspec
+    from .mesh import PP_AXIS
+    from .pp import PpWeight
     from .tp_q80 import TpColWeight, TpRowWeight, tp_col_pspec, tp_row_pspec
 
+    if isinstance(w, PpWeight):
+        # pipeline mode: stage axis on pp, the weight's usual tp split on
+        # the remaining dims (parallel/pp.py)
+        if isinstance(w.w, QuantizedTensor):
+            return PpWeight(QuantizedTensor(
+                P(PP_AXIS, *_pspec_for(name, w.w.packed.ndim - 1, True,
+                                       "packed")),
+                P(PP_AXIS, *_pspec_for(name, w.w.scales.ndim - 1, True,
+                                       "scales"))))
+        return PpWeight(P(PP_AXIS, *_pspec_for(name, w.w.ndim - 1, False,
+                                               "dense")))
     if isinstance(w, (EpRowWeight, EpColWeight)):
         # expert-parallel mode: expert axis on ep (parallel/ep_moe.py)
         return ep_pspec(w)
@@ -108,15 +121,19 @@ def param_pspecs(params: dict) -> dict:
     return out
 
 
-def cache_pspec(sp: bool = False) -> P:
+def cache_pspec(sp: bool = False, pp: bool = False) -> P:
     """Per-layer KV cache leaf (B, KVH, S, hs): batch on dp, kv-heads on tp
     (ref: KvCacheSlice, src/transformer.cpp:161-171). With sp=True the
     sequence dim also shards over sp — per-device cache memory becomes
     seq_len/sp, the long-context scaling axis the reference lacks
-    (SURVEY.md §5.7); decode then attends via sp_cache_attention."""
-    from .mesh import SP_AXIS
+    (SURVEY.md §5.7); decode then attends via sp_cache_attention. With
+    pp=True the leaf is stage-stacked (pp, B, KVH, S, hs) and the stage
+    axis shards over pp — each device holds only its layers' cache
+    (parallel/pp.py)."""
+    from .mesh import PP_AXIS, SP_AXIS
 
-    return P(DP_AXIS, TP_AXIS, SP_AXIS if sp else None, None)
+    spec = (DP_AXIS, TP_AXIS, SP_AXIS if sp else None, None)
+    return P(PP_AXIS, *spec) if pp else P(*spec)
 
 
 def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:
@@ -198,10 +215,9 @@ def shard_params(params: dict, mesh) -> dict:
         return jax.device_put(w, NamedSharding(mesh, s))
 
     def put_entry(w, sp):
-        from .ep_moe import EpColWeight, EpRowWeight
-        from .tp_q80 import TpColWeight, TpRowWeight
+        from .wrappers import WeightWrapper
 
-        if isinstance(w, (TpColWeight, TpRowWeight, EpColWeight, EpRowWeight)):
+        if isinstance(w, WeightWrapper):
             return type(w)(put_entry(w.w, sp.w))
         if isinstance(w, QuantizedTensor):
             return QuantizedTensor(put(w.packed, sp.packed), put(w.scales, sp.scales))

@@ -34,8 +34,6 @@ and the partial sums reduce via the two-shot quantized all-reduce
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -44,11 +42,11 @@ from ..ops.matmul import local_matmul
 from ..quants.jax_codec import QuantizedTensor
 from .collectives import q80_psum_2shot
 from .mesh import DP_AXIS, SP_AXIS, TP_AXIS
+from .wrappers import WeightWrapper, weight_marker
 
 
-@jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
-class TpColWeight:
+@weight_marker
+class TpColWeight(WeightWrapper):
     """A col-split weight repacked as a (tp, ..., d, n/tp) stack.
 
     `w` is a dense array or a QuantizedTensor whose packed/scales carry the
@@ -58,17 +56,9 @@ class TpColWeight:
 
     w: QuantizedTensor | jax.Array
 
-    def tree_flatten(self):
-        return (self.w,), None
 
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
-
-
-@jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
-class TpRowWeight:
+@weight_marker
+class TpRowWeight(WeightWrapper):
     """A row-split (output-dim) matmul weight, marked for shard_map kernel
     execution. No repacking: the d axis shards contiguously, so each local
     block is itself a valid weight for its output rows (the reference's
@@ -77,13 +67,6 @@ class TpRowWeight:
     through shard_map so the Pallas kernel sees local (unsharded) operands."""
 
     w: QuantizedTensor | jax.Array
-
-    def tree_flatten(self):
-        return (self.w,), None
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
 
 
 def tp_row_pspec(w: TpRowWeight) -> TpRowWeight:

@@ -1,0 +1,33 @@
+"""Shared base for the weight-marker pytree wrappers.
+
+The explicit shard_map execution paths mark weights with one-field wrapper
+dataclasses (TpRowWeight/TpColWeight in tp_q80.py, EpRowWeight/EpColWeight
+in ep_moe.py, PpWeight in pp.py) so matmul()/forward() dispatch on type.
+They all share the same shape — `w` holding a dense array or
+QuantizedTensor — so the pytree boilerplate and the generic "unwrap, place,
+rewrap" handling (sharding.shard_params) live here once; only the
+PartitionSpec layout differs per marker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+class WeightWrapper:
+    """Base for one-field weight markers; subclasses add only semantics."""
+
+    def tree_flatten(self):
+        return (self.w,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def weight_marker(cls):
+    """Class decorator: dataclass + pytree registration for a WeightWrapper
+    subclass declaring the single `w` field."""
+    return jax.tree_util.register_pytree_node_class(dataclasses.dataclass(cls))

@@ -110,6 +110,27 @@ class Engine:
             assert spec.n_experts % ep == 0, (
                 f"ep={ep} must divide n_experts={spec.n_experts}")
             self._tp_mesh = mesh
+        # pp > 1: layers are PLACED in stages across the pp axis (L/pp layers
+        # + their KV cache per device — net-new vs the reference, where every
+        # node runs every layer). The layer loop runs inside a partial-manual
+        # shard_map (parallel/pp.py); tp/dp stay GSPMD-auto inside it, so the
+        # explicit shard_map kernel/q80 paths cannot compose with pp.
+        from ..parallel.mesh import PP_AXIS
+
+        pp = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
+        self._pp = pp
+        self._pp_mesh = mesh if pp > 1 else None
+        if pp > 1:
+            assert spec.n_layers % pp == 0, (
+                f"pp={pp} must divide n_layers={spec.n_layers}")
+            assert sp == 1, "pp does not compose with sp yet"
+            assert ep == 1, "pp does not compose with ep yet"
+            assert not self.q80_collectives, (
+                "pp uses GSPMD-exact tp reduces; --buffer-float-type q80 "
+                "is not supported with --pp")
+            mesh_kernels = False
+            self.use_pallas = False
+            self._tp_mesh = None
 
         if tp == 1:
             # single-shard fast path: fused QKV / w1|w3 kernel calls
@@ -137,8 +158,13 @@ class Engine:
                 from ..parallel.sharding import wrap_row_weights
 
                 params = wrap_row_weights(params)
+            if pp > 1:
+                from ..parallel.pp import stack_stages
+
+                params = stack_stages(params, pp)
             self.params = shard_params(params, mesh)
-            self._cache_sharding = NamedSharding(mesh, cache_pspec(sp=sp > 1))
+            self._cache_sharding = NamedSharding(
+                mesh, cache_pspec(sp=sp > 1, pp=pp > 1))
             self._token_sharding = NamedSharding(mesh, P(DP_AXIS, None))
         else:
             self.params = params
@@ -162,11 +188,13 @@ class Engine:
         # server hot path (per-request) and must not retrace.
         if self._cache_maker is None:
             n_l = self.spec.n_layers
+            if self._pp > 1:  # stage-stacked: n_layers/pp leaves (pp, ...)
+                n_l //= self._pp
             shardings = KVCache((self._cache_sharding,) * n_l,
                                 (self._cache_sharding,) * n_l)
             self._cache_maker = jax.jit(
                 lambda: KVCache.create(self.spec, self.batch, self.seq_len,
-                                       self.cache_dtype),
+                                       self.cache_dtype, pp=self._pp),
                 out_shardings=shardings)
         return self._cache_maker()
 
@@ -213,6 +241,10 @@ class Engine:
             per = measure_allreduce_ms(self.mesh, self.spec.dim)
             reduces = (1 + self.spec.n_active_experts) if self.spec.is_moe else 2
             total += per * reduces * self.spec.n_layers
+        pp = self.mesh.shape.get("pp", 1)
+        if pp > 1:  # per-stage activation handoff (parallel/pp.py)
+            total += (measure_allreduce_ms(self.mesh, self.spec.dim,
+                                           axes=("pp",)) * pp)
         return total
 
     # -- compiled steps ---------------------------------------------------
@@ -229,6 +261,7 @@ class Engine:
             tp_reduce=self.tp_reduce,
             pallas_interpret=self.pallas_interpret,
             sp_cache_mesh=self._sp_cache_mesh,
+            pp_mesh=self._pp_mesh,
         )
 
     def _compiled_step(self, key, *, sp_mesh=None,

@@ -98,6 +98,11 @@ def estimate_decode_wire(
     if sp > 1:
         stat = spec.n_heads * spec.head_size + 2 * spec.n_heads  # acc + m + l
         bd["sp_attn_merge"] = spec.n_layers * _ar(sp, stat * b_local * 4)
+    pp = mesh.shape.get("pp", 1)
+    if pp > 1:
+        # one masked-psum live-stage broadcast of the activations per stage
+        # (parallel/pp.py)
+        bd["pp_stage_handoff"] = pp * _ar(pp, spec.dim * b_local * act_bytes)
 
     total = sum(bd.values())
     return WireEstimate(total / 1024.0,

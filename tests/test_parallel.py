@@ -30,7 +30,7 @@ from test_model_forward import make_spec, dense_weights
 
 def test_mesh_axes():
     mesh = make_mesh(tp=4, dp=2)
-    assert mesh.shape == {"dp": 2, "sp": 1, "ep": 1, "tp": 4}
+    assert mesh.shape == {"dp": 2, "sp": 1, "ep": 1, "pp": 1, "tp": 4}
 
 
 @pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
