@@ -64,6 +64,27 @@ def _to_q40_host(x: np.ndarray) -> HostTensor:
     return HostTensor("", FloatType.Q40, x.shape, scales=scales, packed=packed)
 
 
+def _q40_raw_stack(ts: list[HostTensor]) -> tuple[np.ndarray, np.ndarray]:
+    """(packed, scales) in raw block layout for one tensor or an E-stacked
+    expert list — the single host-side Q40 pipeline every load path uses."""
+    qs = [t if t.ftype == FloatType.Q40 else _to_q40_host(t.to_f32())
+          for t in ts]
+    packed = np.stack([q.packed for q in qs]) if len(ts) > 1 else qs[0].packed
+    scales = np.stack([q.scales for q in qs]) if len(ts) > 1 else qs[0].scales
+    return packed, scales
+
+
+def _q40_host_stack(ts: list[HostTensor]) -> tuple[np.ndarray, np.ndarray]:
+    """Like _q40_raw_stack but in the flattened device layout."""
+    packed, scales = _q40_raw_stack(ts)
+    return QuantizedTensor.host_layout(scales, packed)
+
+
+def _dense_host_stack(ts: list[HostTensor]) -> np.ndarray:
+    return (np.stack([t.to_f32() for t in ts]) if len(ts) > 1
+            else ts[0].to_f32())
+
+
 class _Placer:
     """Converts one host tensor (or fusion group) to device arrays with the
     right NamedSharding, tracking q80-collective col repacking and
@@ -92,10 +113,9 @@ class _Placer:
         """A matmul weight: single tensor, or an E-stacked expert list.
         Applies mode (dense/q40), col repack for q80 collectives, ep
         placement for MoE expert stacks, sharding."""
-        stacked = len(ts) > 1
         moe_ep = self.ep > 1 and key in _MOE_EP_KEYS
         if self.mode != "q40":
-            x = np.stack([t.to_f32() for t in ts]) if stacked else ts[0].to_f32()
+            x = _dense_host_stack(ts)
             x = x.astype(np.dtype(self.dtype) if self.dtype != jnp.bfloat16
                          else np.float32)
             if (self.q80 or moe_ep) and key in COL_SPLIT_NAMES:
@@ -121,10 +141,7 @@ class _Placer:
             arr = self._put(x, _pspec_for(key, x.ndim, False, "dense"))
             return arr.astype(self.dtype) if self.dtype == jnp.bfloat16 else arr
 
-        qs = [t if t.ftype == FloatType.Q40 else _to_q40_host(t.to_f32())
-              for t in ts]
-        packed = np.stack([q.packed for q in qs]) if stacked else qs[0].packed
-        scales = np.stack([q.scales for q in qs]) if stacked else qs[0].scales
+        packed, scales = _q40_raw_stack(ts)
         if (self.q80 or moe_ep) and key in COL_SPLIT_NAMES:
             return self._col_q40(packed, scales, ep=moe_ep)
         pk, sc = QuantizedTensor.host_layout(scales, packed)
@@ -171,6 +188,68 @@ def _col_stack_pspec(ndim: int, ep: bool = False):
     if ep:  # (tp, E, d, ...): tp stack on tp, experts on ep
         return P(TP_AXIS, EP_AXIS, *([None] * (ndim - 2)))
     return P(TP_AXIS, *([None] * (ndim - 1)))
+
+
+class _PpStacker:
+    """Builds stage-stacked PpWeight leaves (parallel/pp.py) one layer
+    tensor at a time: a zero-initialized (pp, ...) buffer sharded over pp
+    receives each stage's row via a donated dynamic_update_slice, so the
+    per-device footprint is the final L/pp share plus one transient host
+    tensor — never the full-L restack the engine-side path pays."""
+
+    def __init__(self, mesh, pp: int):
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        self.mesh = mesh
+        self.pp = pp
+        self._P = P
+
+        @functools.partial(jax.jit, donate_argnums=0, static_argnums=3)
+        def update(buf, row, stage, sharding):
+            row = row.astype(buf.dtype)[None]
+            start = (stage,) + (0,) * (buf.ndim - 1)
+            out = jax.lax.dynamic_update_slice(buf, row, start)
+            return jax.lax.with_sharding_constraint(out, sharding)
+
+        @functools.partial(jax.jit, static_argnums=(0, 1, 2))
+        def zeros(shape, dtype, sharding):
+            return jax.lax.with_sharding_constraint(
+                jnp.zeros(shape, dtype), sharding)
+
+        self._update = update
+        self._zeros = zeros  # one jit each — cache hits per distinct shape
+
+    def _row(self, buf, arr: np.ndarray, stage: int, inner_pspec, dtype):
+        sh = NamedSharding(self.mesh, self._P("pp", *inner_pspec))
+        if buf is None:
+            buf = self._zeros((self.pp,) + arr.shape, jnp.dtype(dtype), sh)
+        return self._update(buf, jnp.asarray(arr), stage, sh)
+
+    def add(self, slot: dict, key: str, stage: int, mode: str, dtype,
+            ts: list[HostTensor], *, keep_f32: bool = False):
+        """Fold one layer tensor (or fused/expert-stacked group) into the
+        slot's stage-stacked leaf."""
+        from ..parallel.pp import PpWeight
+
+        cur = slot.get(key)
+        if mode != "q40" or keep_f32:
+            x = _dense_host_stack(ts)
+            leaf_dtype = jnp.float32 if keep_f32 else dtype
+            spec = _pspec_for(key, x.ndim, False, "dense")
+            slot[key] = PpWeight(self._row(
+                cur.w if cur is not None else None, x, stage, spec,
+                leaf_dtype))
+            return
+        pk, sc = _q40_host_stack(ts)
+        old = cur.w if cur is not None else None
+        slot[key] = PpWeight(QuantizedTensor(
+            self._row(old.packed if old is not None else None, pk, stage,
+                      _pspec_for(key, pk.ndim, True, "packed"), pk.dtype),
+            self._row(old.scales if old is not None else None, sc, stage,
+                      _pspec_for(key, sc.ndim, True, "scales"), sc.dtype),
+        ))
 
 
 def _ep_row_pspec(ndim: int):
@@ -221,21 +300,34 @@ def load_params_streamed(
     assert mode in ("dense", "q40")
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
     ep = mesh.shape.get(EP_AXIS, 1) if mesh is not None else 1
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     if fuse is None:
         fuse = tp == 1
+    if pp > 1:
+        assert spec.n_layers % pp == 0, (spec.n_layers, pp)
+        assert not q80_collectives and ep == 1, (
+            "pp loading composes with tp/dp only (matching Engine)")
+    n_slot = spec.n_layers // pp
     placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep)
+    pp_stack = _PpStacker(mesh, pp) if pp > 1 else None
 
-    p: dict = {"layers": [dict() for _ in range(spec.n_layers)]}
+    p: dict = {"layers": [dict() for _ in range(n_slot if pp > 1
+                                                else spec.n_layers)]}
     pending: dict[str, list[HostTensor]] = {}
     peak = 0
     total = 0
     live = 0
 
     def target(plan_name: str):
+        """(dest dict, stage) — stage is None for non-layer tensors; under
+        pp layer l maps to slot l % n_slot at stage l // n_slot."""
         parts = plan_name.split(".")
-        if parts[0] == "layers":
-            return p["layers"][int(parts[1])]
-        return p
+        if parts[0] != "layers":
+            return p, None
+        l = int(parts[1])
+        if pp > 1:
+            return p["layers"][l % n_slot], l // n_slot
+        return p["layers"][l], None
 
     for t in iter_model_tensors(path, spec):
         b = _host_bytes(t)
@@ -243,7 +335,7 @@ def load_params_streamed(
         live += b
         peak = max(peak, live)
         key = _leaf_key(t.name)
-        dest = target(t.name)
+        dest, stage = target(t.name)
         group = _fuse_group(key) if fuse else None
 
         if group is not None:
@@ -252,7 +344,11 @@ def load_params_streamed(
             want = 3 if group == "wqkv" else 2
             if len(pending[gk]) == want:
                 ts = pending.pop(gk)
-                dest[group] = placer.weight(group, _concat_host(ts, mode))
+                cts = _concat_host(ts, mode)
+                if stage is not None:
+                    pp_stack.add(dest, group, stage, mode, dtype, cts)
+                else:
+                    dest[group] = placer.weight(group, cts)
                 live -= sum(_host_bytes(x) for x in ts)
             continue
 
@@ -262,17 +358,30 @@ def load_params_streamed(
             pending.setdefault(gk, []).append(t)
             if len(pending[gk]) == spec.n_experts:
                 ts = pending.pop(gk)
-                dest[key] = placer.weight(key, ts)
+                if stage is not None:
+                    pp_stack.add(dest, key, stage, mode, dtype, ts)
+                else:
+                    dest[key] = placer.weight(key, ts)
                 live -= sum(_host_bytes(x) for x in ts)
             continue
 
         if key in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2", "rms_final"):
-            dest[key] = placer.dense(key, t.to_f32())  # norms stay f32
+            if stage is not None:  # per-layer norms stack too, kept f32
+                pp_stack.add(dest, key, stage, "dense", dtype, [t],
+                             keep_f32=True)
+            else:
+                dest[key] = placer.dense(key, t.to_f32())  # norms stay f32
         elif key in ("tok_emb", "moe_router"):
-            arr = placer.dense(key, t.to_f32())
-            dest[key] = arr.astype(dtype) if dtype != jnp.float32 else arr
+            if stage is not None:  # moe_router is a per-layer dense leaf
+                pp_stack.add(dest, key, stage, "dense", dtype, [t])
+            else:
+                arr = placer.dense(key, t.to_f32())
+                dest[key] = arr.astype(dtype) if dtype != jnp.float32 else arr
         else:
-            dest[key] = placer.weight(key, [t])
+            if stage is not None:
+                pp_stack.add(dest, key, stage, mode, dtype, [t])
+            else:
+                dest[key] = placer.weight(key, [t])
         live -= b
 
     assert not pending, f"incomplete fusion groups: {list(pending)}"

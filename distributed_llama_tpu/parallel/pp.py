@@ -55,6 +55,8 @@ def stack_stages(params: dict, pp: int) -> dict:
     stages' weights: new_layers[j] leaf = stack(layers[s*L/pp + j] for s).
     Leaves become PpWeight so sharding/spec code routes them."""
     layers = params["layers"]
+    if layers and any(isinstance(v, PpWeight) for v in layers[0].values()):
+        return params  # already stage-stacked (the streamed loader's pp mode)
     n_l = len(layers)
     assert n_l % pp == 0, (n_l, pp)
     n_slot = n_l // pp

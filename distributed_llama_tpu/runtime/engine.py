@@ -138,7 +138,12 @@ class Engine:
         if mesh is not None:
             from ..quants.jax_codec import QuantizedTensor
 
-            q40 = any(isinstance(v, QuantizedTensor)
+            from ..parallel.wrappers import WeightWrapper
+
+            def _leaf(v):  # loader-marked leaves wrap the quantized tensor
+                return v.w if isinstance(v, WeightWrapper) else v
+
+            q40 = any(isinstance(_leaf(v), QuantizedTensor)
                       for lw in params["layers"] for v in lw.values())
             check_tp_constraints(spec, tp, q40=q40)
             if ep > 1:

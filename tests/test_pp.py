@@ -94,6 +94,39 @@ def test_pp_dp_batched_ragged_generation():
     assert outs == [want_a, want_b], (outs, [want_a, want_b])
 
 
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
+def test_pp_streamed_loader_places_stages(tmp_path, arch):
+    """The streamed loader must build the stage-stacked leaves directly
+    (one stage row at a time into pp-sharded buffers) so per-device load
+    memory is the final L/pp share — and its output must feed the engine
+    unchanged, matching the single-device greedy stream."""
+    import dataclasses
+
+    from distributed_llama_tpu.io.model_file import write_model
+    from distributed_llama_tpu.models.loader import load_params_streamed
+    from distributed_llama_tpu.quants.types import FloatType
+
+    spec, params = make_params(arch)
+    host, _ = dense_weights(spec, seed=7)
+    want = baseline_tokens(spec, params)
+    q40_spec = dataclasses.replace(spec, weights_float_type=FloatType.Q40)
+    mpath = str(tmp_path / "tiny.m")
+    write_model(mpath, q40_spec, {n: t.to_f32() for n, t in host.items()})
+
+    mesh = make_mesh(pp=2, tp=2, dp=1)
+    loaded, _ = load_params_streamed(q40_spec, mpath, mesh, mode="q40",
+                                     dtype=jnp.float32)
+    lw0 = loaded["layers"][0]
+    assert isinstance(lw0["wq"], PpWeight)
+    pk = lw0["wq"].w.packed
+    assert pk.sharding.spec[0] == "pp"
+    assert pk.sharding.shard_shape(pk.shape)[0] == 1
+    eng = Engine(spec, loaded, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, use_pallas=False)
+    got = eng.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+    assert got == want, (got, want)
+
+
 def test_pp_rejects_unsupported_combos():
     spec, params = make_params()
     with pytest.raises(AssertionError, match="sp"):
