@@ -16,6 +16,7 @@ Env knobs: BENCH_MODEL=7b|tiny, BENCH_TOKENS=<n decode steps>.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 
@@ -110,21 +111,28 @@ def main() -> None:
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
     spec = LLAMA2_7B if model == "7b" else TINY
+    # long-context variants: BENCH_SEQ widens the cache, BENCH_FILL starts
+    # decode at a deep fill (the flash kernel reads ~fill bytes of cache)
+    seq = int(os.environ.get("BENCH_SEQ", str(min(spec.seq_len, 2048))))
+    fill = int(os.environ.get("BENCH_FILL", "0"))
+    assert 0 <= fill < seq - 1, f"BENCH_FILL={fill} must be < BENCH_SEQ-1={seq - 1}"
+    if seq != spec.seq_len:
+        spec = dataclasses.replace(spec, seq_len=seq)
     # decode must fit the KV cache: decode_greedy_device has no per-step
     # overflow guard, so steps past seq_len would silently measure garbage
-    n_tokens = min(n_tokens, spec.seq_len - 1)
+    n_tokens = min(n_tokens, seq - fill - 1)
 
     params = synth_q40_params(spec)
     engine = Engine(
         spec, params,
         compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
-        max_seq_len=min(spec.seq_len, 2048))
+        max_seq_len=seq)
 
     # best-of-N: the tunneled platform adds run-to-run jitter of ~1 ms/token
     repeats = int(os.environ.get("BENCH_REPEATS", "2"))
     dt = None
     for _ in range(repeats):
-        engine.pos = 0
+        engine.pos = fill
         _, d = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
         dt = d if dt is None else min(dt, d)
     ms_per_token = dt / n_tokens * 1e3

@@ -115,12 +115,18 @@ def build_engine(args):
         mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp, ep=args.ep,
                          pp=args.pp)
 
+    q80 = args.buffer_float_type == "q80"
+    if q80 and args.pp > 1:
+        # pipeline stages reduce with GSPMD-exact collectives; the quantized
+        # exchange cannot nest inside the manual-pp region
+        print("⏩ --pp uses exact collectives; ignoring --buffer-float-type q80")
+        q80 = False
+
     # streamed sharded load: one tensor resident at a time, each shard
     # placed straight onto its device (ref weight push: transformer.cpp:562-621)
     t0 = time.time()
     params, lstats = load_params_streamed(
-        spec, args.model, mesh, mode=mode, dtype=cdt,
-        q80_collectives=(args.buffer_float_type == "q80"))
+        spec, args.model, mesh, mode=mode, dtype=cdt, q80_collectives=q80)
     print(f"⏩ loaded {lstats.total_bytes / 1e9:.2f} GB in "
           f"{time.time()-t0:.1f}s (peak host "
           f"{lstats.peak_host_bytes / 1e6:.0f} MB)")
@@ -129,8 +135,8 @@ def build_engine(args):
         batch=max(args.dp, 1),
         max_seq_len=args.max_seq_len,
         compute_dtype=cdt, cache_dtype=kdt,
-        activation_q80=(args.buffer_float_type == "q80" and mode == "q40"),
-        q80_collectives=(args.buffer_float_type == "q80"),
+        activation_q80=(q80 and mode == "q40"),
+        q80_collectives=q80,
         use_pallas=args.pallas,  # None -> engine default (on for TPU)
     )
 

@@ -30,7 +30,7 @@ from ..quants.jax_codec import QuantizedTensor
 from ..quants.numpy_codec import quantize_q40
 from ..quants.types import FloatType
 from ..parallel.sharding import COL_SPLIT_NAMES, _pspec_for
-from ..parallel.mesh import EP_AXIS, TP_AXIS
+from ..parallel.mesh import EP_AXIS, PP_AXIS, TP_AXIS
 from .spec import ArchType, ModelSpec
 
 _MOE_EP_KEYS = ("moe_up", "moe_gate", "moe_down")
@@ -222,7 +222,7 @@ class _PpStacker:
         self._zeros = zeros  # one jit each — cache hits per distinct shape
 
     def _row(self, buf, arr: np.ndarray, stage: int, inner_pspec, dtype):
-        sh = NamedSharding(self.mesh, self._P("pp", *inner_pspec))
+        sh = NamedSharding(self.mesh, self._P(PP_AXIS, *inner_pspec))
         if buf is None:
             buf = self._zeros((self.pp,) + arr.shape, jnp.dtype(dtype), sh)
         return self._update(buf, jnp.asarray(arr), stage, sh)
@@ -300,7 +300,7 @@ def load_params_streamed(
     assert mode in ("dense", "q40")
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
     ep = mesh.shape.get(EP_AXIS, 1) if mesh is not None else 1
-    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    pp = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
     if fuse is None:
         fuse = tp == 1
     if pp > 1:

@@ -125,7 +125,13 @@ def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
                     per_row_pos=per_row_pos, write_gate=gate)
                 k_l[j] = k_new[None]
                 v_l[j] = v_new[None]
-            x_l = lax.psum(jnp.where(gate, y, jnp.zeros_like(y)), PP_AXIS)
+            # live-stage broadcast; the psum payload is upcast to f32 — XLA's
+            # CPU backend miscompiles a bf16 all-reduce inside the manual
+            # region ("Invalid binary instruction opcode copy"), and the
+            # handoff is numerically the residual stream, where f32 transit
+            # loses nothing
+            live = jnp.where(gate, y, jnp.zeros_like(y)).astype(jnp.float32)
+            x_l = lax.psum(live, PP_AXIS).astype(y.dtype)
         return x_l, tuple(k_l), tuple(v_l)
 
     def wspec(w):

@@ -94,6 +94,19 @@ def test_pp_dp_batched_ragged_generation():
     assert outs == [want_a, want_b], (outs, [want_a, want_b])
 
 
+def test_pp_bf16_engine_runs():
+    """bf16 compute/cache under pp (the CLI's defaults): regression for an
+    XLA CPU miscompile of a bf16 all-reduce inside the manual region — the
+    stage handoff transits in f32 (parallel/pp.py)."""
+    spec, params = make_params(mode="q40")
+    eng = Engine(spec, params, make_mesh(pp=2, tp=2, dp=2), batch=2,
+                 compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
+                 use_pallas=False)
+    outs = eng.generate_batch([PROMPT, PROMPT[:2]], max_tokens=3,
+                              sampler=greedy())
+    assert len(outs) == 2 and all(len(o) == 3 for o in outs)
+
+
 @pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL])
 def test_pp_streamed_loader_places_stages(tmp_path, arch):
     """The streamed loader must build the stage-stacked leaves directly
