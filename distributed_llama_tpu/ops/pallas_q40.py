@@ -82,7 +82,7 @@ def _f16_bits_to_f32(u: jnp.ndarray) -> jnp.ndarray:
 
 
 def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
-            *, nb, out_dtype, scales_u16):
+            *, nb, out_dtype, scales_u16, mxu_bf16):
     pk = packed_ref[:].astype(jnp.int32)                 # (TD, M=16*nb)
     lo = (pk & 0xF).astype(jnp.float32)
     hi = (pk >> 4).astype(jnp.float32)
@@ -101,8 +101,18 @@ def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.DEFAULT,
     )
-    acc = dot(x_lo_ref[:], lo * s16)                     # (T, TD)
-    acc += dot(x_hi_ref[:], hi * s16)
+    wl, wh = lo * s16, hi * s16
+    x_lo, x_hi = x_lo_ref[:], x_hi_ref[:]
+    if mxu_bf16:
+        # multi-token (prefill) chunks are MXU-bound: f32 feeds cap the MXU
+        # at 1/4 of its bf16 rate (v5e 49 vs 197 TFLOP/s), so cast the
+        # dequantized tiles and activations down. 4-bit weight levels and
+        # bf16 engine activations fit bf16 exactly; only requested when the
+        # caller's out_dtype is bf16 (decode t=1 stays f32/VPU-bound)
+        wl, wh = wl.astype(jnp.bfloat16), wh.astype(jnp.bfloat16)
+        x_lo, x_hi = x_lo.astype(jnp.bfloat16), x_hi.astype(jnp.bfloat16)
+    acc = dot(x_lo, wl)                                  # (T, TD)
+    acc += dot(x_hi, wh)
     acc += dot(xsum_ref[:], s) * -8.0                    # fold every (nib-8) offset
     out_ref[:] = acc.astype(out_dtype)
 
@@ -165,10 +175,13 @@ def q40_matmul(
     grid = (d // td,)
     scales_u16 = w.scales.dtype == jnp.uint16
     scales = w.scales if scales_u16 else w.scales.astype(jnp.float32)
+    # multi-token chunks with a bf16 consumer take the bf16 MXU feed (see
+    # _kernel); single-token decode and f32 consumers keep exact f32
+    mxu_bf16 = jnp.dtype(out_dtype) == jnp.bfloat16 and t >= 16
 
     out = pl.pallas_call(
         functools.partial(_kernel, nb=nb, out_dtype=out_dtype,
-                          scales_u16=scales_u16),
+                          scales_u16=scales_u16, mxu_bf16=mxu_bf16),
         grid=grid,
         in_specs=[
             pl.BlockSpec((t, m), lambda i: (0, 0), memory_space=pltpu.VMEM),

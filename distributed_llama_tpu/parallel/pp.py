@@ -32,6 +32,7 @@ inference-latency orientation.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -125,13 +126,15 @@ def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
                     per_row_pos=per_row_pos, write_gate=gate)
                 k_l[j] = k_new[None]
                 v_l[j] = v_new[None]
-            # live-stage broadcast; the psum payload is upcast to f32 — XLA's
-            # CPU backend miscompiles a bf16 all-reduce inside the manual
-            # region ("Invalid binary instruction opcode copy"), and the
-            # handoff is numerically the residual stream, where f32 transit
-            # loses nothing
-            live = jnp.where(gate, y, jnp.zeros_like(y)).astype(jnp.float32)
-            x_l = lax.psum(live, PP_AXIS).astype(y.dtype)
+            # live-stage broadcast. On the CPU backend only, the psum payload
+            # is upcast to f32: XLA's CPU compiler miscompiles a bf16
+            # all-reduce inside the manual region ("Invalid binary
+            # instruction opcode copy"); TPU keeps the native-width payload
+            live = jnp.where(gate, y, jnp.zeros_like(y))
+            if jax.default_backend() == "cpu" and live.dtype == jnp.bfloat16:
+                x_l = lax.psum(live.astype(jnp.float32), PP_AXIS).astype(y.dtype)
+            else:
+                x_l = lax.psum(live, PP_AXIS)
         return x_l, tuple(k_l), tuple(v_l)
 
     def wspec(w):

@@ -51,9 +51,11 @@ def test_cli_mesh_flags_end_to_end(tmp_path, rng, capsys):
     a dp-batched generation over tp-split weights in pp stages must produce
     the same tokens as the single-device run (greedy, fixed seed)."""
     mpath, tpath = _fixture(tmp_path, rng)
+    # f32 buffers on both runs: the pp run force-disables q80, so the
+    # baseline must not use it either or the comparison is approximate
     base_args = ["generate", "--model", mpath, "--tokenizer", tpath,
                  "--prompt", "ab", "--steps", "3", "--seed", "7",
-                 "--temperature", "0"]
+                 "--temperature", "0", "--buffer-float-type", "f32"]
     dllama.main(base_args)
     want = capsys.readouterr().out
     dllama.main(base_args + ["--tp", "2", "--pp", "2", "--dp", "2"])

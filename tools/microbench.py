@@ -25,7 +25,7 @@ from distributed_llama_tpu.ops.attention import decode_attention
 
 L, D, H = 32, 4096, 11008
 SEQ, KVH, HS = 2048, 32, 128
-R1, R2 = 2, 10
+R1, R2 = 4, 32  # wide spread: tunnel jitter ~1-2 ms swamps small slopes
 
 
 def slope_time(make_run, *args):
@@ -115,8 +115,9 @@ def bench_gemv_pallas():
 
 
 def bench_attn():
-    k = jnp.zeros((L, 1, SEQ, KVH, HS), jnp.bfloat16)
-    v = jnp.zeros((L, 1, SEQ, KVH, HS), jnp.bfloat16)
+    # head-major cache layout (B, KVH, S, hs) — models/transformer.KVCache
+    k = jnp.zeros((L, 1, KVH, SEQ, HS), jnp.bfloat16)
+    v = jnp.zeros((L, 1, KVH, SEQ, HS), jnp.bfloat16)
     q0 = jnp.ones((1, 1, KVH, HS), jnp.bfloat16)
     pos = jnp.full((1, 1), SEQ - 1, jnp.int32)
 
@@ -134,13 +135,14 @@ def bench_attn():
 
 
 def bench_cache():
-    k = jnp.zeros((L, 1, SEQ, KVH, HS), jnp.bfloat16)
+    k = jnp.zeros((L, 1, KVH, SEQ, HS), jnp.bfloat16)
     new0 = jnp.ones((1, 1, KVH, HS), jnp.bfloat16)
 
     def body(new, k):
         def layer(new, kl):
-            kl = jax.lax.dynamic_update_slice(kl, new, (0, SEQ - 1, 0, 0))
-            return new + kl[:, -1] * jnp.bfloat16(1e-6), kl
+            kl = jax.lax.dynamic_update_slice(
+                kl, new.transpose(0, 2, 1, 3), (0, 0, SEQ - 1, 0))
+            return new + kl[:, :, -1] * jnp.bfloat16(1e-6), kl
         new, k2 = jax.lax.scan(layer, new, k)
         return new
 
