@@ -27,10 +27,11 @@ Decode roofline (measured v5e): the ~7 VPU ops per packed byte above cap
 the kernel at ~475 GB/s of packed-byte throughput (v5e VPU ~3.8 Tops/s),
 and whole-model decode measures 409-472 GB/s effective — the kernel runs
 at its VPU design ceiling, not the 819 GB/s HBM ceiling. Cutting ops/byte
-further means int8 MXU dots, but Q40's 32-element block scales force a
-k=32 contraction granularity that wastes the 128-lane MXU; Q80 weights
-would unpack cheaper (~2.5 ops/byte) but carry 1.9x the bytes, a net
-loss. 7B Q40 decode lands at ~9.5 ms/token accordingly.
+further means int8 MXU dots — measured and REJECTED: an int4-unpack ->
+int8 dot_general variant runs 4x slower at t=1 (82 vs 331 GB/s packed,
+tools/exp_int8_dot.py) because Mosaic has no efficient int8 gemv path;
+Q80 weights would unpack cheaper (~2.5 ops/byte) but carry 1.9x the
+bytes, a net loss. 7B Q40 decode lands at ~9.5 ms/token accordingly.
 
 Layout: QuantizedTensor packed is nibble-position-major, stored flattened
 (d, m) uint8 with lane order m = j*nb + b (see quants/jax_codec.py) — the
