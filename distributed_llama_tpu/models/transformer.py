@@ -77,6 +77,16 @@ class KVCache(NamedTuple):
         )
 
 
+def _to_cache_dtype(x, dtype):
+    """Cast k/v to the cache dtype; sub-bf16 caches (fp8 e4m3) saturate at
+    the format's max first — the jax cast is non-saturating and |v| > 448
+    would become NaN, permanently poisoning every later attention read."""
+    if jnp.dtype(dtype).itemsize < 2:
+        lim = float(jnp.finfo(dtype).max)
+        x = jnp.clip(x, -lim, lim)
+    return x.astype(dtype)
+
+
 def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
                      sp_mesh=None, sp_cache_mesh=None, per_row_pos=False,
                      write_gate=None):
@@ -123,13 +133,13 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
         else:
             q_write = q_pos
         k_cache = k_cache.at[bidx, :, q_write].set(
-            k.astype(k_cache.dtype), mode="drop")
+            _to_cache_dtype(k, k_cache.dtype), mode="drop")
         v_cache = v_cache.at[bidx, :, q_write].set(
-            v.astype(v_cache.dtype), mode="drop")
+            _to_cache_dtype(v, v_cache.dtype), mode="drop")
     else:
         pos0 = q_pos[:, 0]
-        k_w = k.transpose(0, 2, 1, 3).astype(k_cache.dtype)
-        v_w = v.transpose(0, 2, 1, 3).astype(v_cache.dtype)
+        k_w = _to_cache_dtype(k.transpose(0, 2, 1, 3), k_cache.dtype)
+        v_w = _to_cache_dtype(v.transpose(0, 2, 1, 3), v_cache.dtype)
         if write_gate is not None:
             start = (0, 0, pos0[0], 0)
             k_w = jnp.where(write_gate, k_w,

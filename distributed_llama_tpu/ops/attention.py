@@ -36,7 +36,12 @@ def decode_attention(
     # 32 layers @ seq 2048 on v5e after this change); the MXU accumulates
     # bf16 contractions in f32 natively via preferred_element_type. The
     # cache is head-major (see models/transformer.KVCache) so each head's
-    # (S, hs) panel reads sequentially.
+    # (S, hs) panel reads sequentially. Sub-bf16 caches (the fp8 option —
+    # half the cache bytes) upcast at the dot operand, where XLA fuses the
+    # convert into the read; q/probs never narrow below the compute dtype.
+    if jnp.dtype(k_cache.dtype).itemsize < 2:
+        k_cache = k_cache.astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype)
     qg = q.reshape(b, t, kvh, group, hs)
 
     # scores: (B, T, KVH, G, S)

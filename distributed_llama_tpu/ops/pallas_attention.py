@@ -59,6 +59,11 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
         q = q_ref[0]                               # (G, hs)
         k = k_ref[0]                               # (SB, hs)
         v = v_ref[0]
+        if k.dtype != q.dtype:
+            # sub-bf16 cache (fp8 option): HBM/VMEM stay narrow, the upcast
+            # is per-block VPU work right before the dot
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
 
         dot = functools.partial(
             jax.lax.dot_general,
@@ -114,8 +119,11 @@ def flash_decode_attention(
     n_sb = s // sb
 
     # kernel dots need matching operand dtypes (lax.dot_general does not
-    # promote); compute dtype and cache dtype may differ
-    q = q.astype(k_cache.dtype)
+    # promote); compute dtype and cache dtype may differ. Wider caches
+    # (f32) lift q; narrower caches (fp8) are lifted per-block in-kernel —
+    # q and the softmax state never drop below the compute dtype
+    if jnp.dtype(k_cache.dtype).itemsize >= 2:
+        q = q.astype(k_cache.dtype)
     qh = q.reshape(b, kvh, g, hs).reshape(b * kvh, g, hs)
     kh = k_cache.reshape(b * kvh, s, hs)
     vh = v_cache.reshape(b * kvh, s, hs)

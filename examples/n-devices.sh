@@ -11,5 +11,6 @@ JAX_PLATFORMS=cpu python - <<EOF
 import __graft_entry__ as g
 g.dryrun_multichip($N)
 print("✅ dp x tp batched generation, sp ring prefill + sp-cache decode,")
-print("   and q80-collective TP all ran on a $N-device mesh")
+print("   q80-collective TP, shard_map Pallas kernels, ep expert placement")
+print("   and pp pipeline stages all ran on a $N-device mesh")
 EOF

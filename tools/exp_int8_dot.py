@@ -20,7 +20,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-sys.path.insert(0, "/root/repo")
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
 from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
@@ -31,7 +32,7 @@ G = K // 128           # scale groups of 128
 REPS = 64
 
 
-def _kernel(xq_ref, xs_ref, pk_ref, sc_ref, o_ref, *, td):
+def _kernel(xq_ref, pk_ref, sc_ref, o_ref, *, td):
     # pk: (TD, K/2) uint8; byte j holds col j (lo nibble) and col K/2+j
     # (hi nibble) — a pack-time column split, so no interleave is needed
     # and the unpack stays int ops in int8 lanes
@@ -50,20 +51,19 @@ def _kernel(xq_ref, xs_ref, pk_ref, sc_ref, o_ref, *, td):
     o_ref[:] = p.astype(jnp.float32) * sc_ref[:].reshape(1, td)
 
 
-def int8_gemv(xq, xs, pk, sc, td=256):
+def int8_gemv(xq, pk, sc, td=256):
     grid = (D // td,)
     return pl.pallas_call(
         functools.partial(_kernel, td=td),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, K), lambda i: (0, 0)),
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),
             pl.BlockSpec((td, K // 2), lambda i: (i, 0)),
             pl.BlockSpec((td, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((1, td), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((1, D), jnp.float32),
-    )(xq, xs, pk, sc)
+    )(xq, pk, sc)
 
 
 L = 24          # distinct weight instances per pass: stream real HBM bytes
@@ -91,14 +91,13 @@ def main():
     pk = jnp.asarray(rng.integers(0, 256, (L, D, K // 2), dtype=np.uint8))
     sc = jnp.asarray(rng.random((L, D, 1), dtype=np.float32))
     xq0 = jnp.asarray(rng.integers(-8, 8, (1, K), dtype=np.int8))
-    xs = jnp.ones((1, 1), jnp.float32)
 
     def make8(reps):
         def run(pk, sc, xq):
             def rep(xq, _):
                 def layer(xq, wl):
                     p, s = wl
-                    out = int8_gemv(xq, xs, p, s)
+                    out = int8_gemv(xq, p, s)
                     # data dependency without changing values
                     xq = jnp.where(out[0, 0] > 1e30, xq ^ 1, xq)
                     return xq, None
@@ -116,8 +115,8 @@ def main():
     scales, packed = quantize_q40(rng.standard_normal((D, K), np.float32))
     hpk, hsc = QuantizedTensor.host_layout(scales, packed)
     wq = QuantizedTensor(
-        jnp.broadcast_to(jnp.asarray(hpk), (L,) + hpk.shape).reshape((L,) + hpk.shape).copy(),
-        jnp.broadcast_to(jnp.asarray(hsc), (L,) + hsc.shape).reshape((L,) + hsc.shape).copy())
+        jnp.broadcast_to(jnp.asarray(hpk), (L,) + hpk.shape).copy(),
+        jnp.broadcast_to(jnp.asarray(hsc), (L,) + hsc.shape).copy())
     x0 = jnp.ones((1, K), jnp.bfloat16)
 
     def makeq(reps):
