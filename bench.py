@@ -1,9 +1,10 @@
 """Benchmark: Llama-2-7B Q40 decode ms/token on one TPU chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-`vs_baseline` is the speedup factor over the reference's best published
-single-node Llama-2-7B number (101.81 ms/token on a 30-vCPU GCP c3d VM,
-ref: README.md:88; the RasPi-5 single-node figure is 441.09 ms/token).
+`vs_baseline` is the speedup over the reference's best published
+single-node number for the benched model: Llama-2-7B = 101.81 ms/token
+(30-vCPU GCP c3d, ref README.md:88), Llama-3-8B = 564.31 ms/token
+(RasPi 5, ref README.md:61).
 
 Weights are synthetic Q40 blocks generated at the packed-byte level (random
 nibbles + small f16 scales) — decode speed does not depend on weight values,
@@ -11,7 +12,9 @@ and this avoids materializing 28 GB of f32 on the host. The decode path is
 the production one: Engine.decode_greedy_device (fully on-device lax.scan,
 fused argmax, donated KV cache).
 
-Env knobs: BENCH_MODEL=7b|tiny, BENCH_TOKENS=<n decode steps>.
+Env knobs: BENCH_MODEL=7b|8b|tiny (8b = Llama-3-8B GQA/128k-vocab, judged
+against the reference's best 1-node 8B number), BENCH_TOKENS=<n decode
+steps>, BENCH_SEQ/BENCH_FILL for long-context variants.
 """
 
 from __future__ import annotations
@@ -29,11 +32,17 @@ from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
 from distributed_llama_tpu.runtime.engine import Engine
 
 BASELINE_MS_PER_TOKEN = 101.81  # ref README.md:88 — Llama 2 7B, 1x GCP c3d-highcpu-30
+BASELINE_8B_MS_PER_TOKEN = 564.31  # ref README.md:61 — Llama 3 8B, best 1-node (RasPi 5)
 
 LLAMA2_7B = ModelSpec(
     arch=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
     n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
     hidden_act=HiddenAct.SILU)
+
+LLAMA3_8B = ModelSpec(  # GQA + 128k vocab (BASELINE.json config 2)
+    arch=ArchType.LLAMA, dim=4096, hidden_dim=14336, n_layers=32,
+    n_heads=32, n_kv_heads=8, vocab_size=128256, seq_len=2048,
+    hidden_act=HiddenAct.SILU, rope_theta=500000.0)
 
 TINY = ModelSpec(
     arch=ArchType.LLAMA, dim=256, hidden_dim=704, n_layers=4,
@@ -85,17 +94,20 @@ def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict
 V5E_PEAK_BF16_TFLOPS = 197.0  # per chip; override with BENCH_PEAK_TFLOPS
 
 
-def _decode_read_bytes(spec: ModelSpec) -> int:
+def _decode_read_bytes(spec: ModelSpec, avg_fill: float = 0.0,
+                       cache_itemsize: int = 2) -> int:
     """HBM bytes one decode step must read: every layer weight + wcls in
     packed Q40 form (0.5 B/weight + f16-bit scales on device), one embedding
-    row, norms. The roofline denominator for effective-bandwidth."""
+    row, norms, plus the K/V cache rows attention reads at the average fill
+    depth. The roofline denominator for effective-bandwidth."""
     d, h, kv, v = spec.dim, spec.hidden_dim, spec.kv_dim, spec.vocab_size
     per_layer_vals = d * d * 2 + kv * d * 2 + h * d * 2 + d * h
     total_vals = per_layer_vals * spec.n_layers + v * d  # + wcls
     packed = total_vals // 2               # device layout: 16 B per 32 nibbles
     scale_w = 4 if os.environ.get("BENCH_SCALES") == "f32" else 2
     scales = total_vals // 32 * scale_w    # uint16 f16-bit (or A/B f32) scales
-    return packed + scales + d * 4 * (2 * spec.n_layers + 1) + d * 2
+    cache = int(avg_fill) * 2 * kv * spec.n_layers * cache_itemsize  # k + v
+    return packed + scales + d * 4 * (2 * spec.n_layers + 1) + d * 2 + cache
 
 
 def _decode_flops(spec: ModelSpec) -> int:
@@ -110,7 +122,7 @@ def main() -> None:
     # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
-    spec = LLAMA2_7B if model == "7b" else TINY
+    spec = {"7b": LLAMA2_7B, "8b": LLAMA3_8B}.get(model, TINY)
     # long-context variants: BENCH_SEQ widens the cache, BENCH_FILL starts
     # decode at a deep fill (the flash kernel reads ~fill bytes of cache)
     seq = int(os.environ.get("BENCH_SEQ", str(min(spec.seq_len, 2048))))
@@ -141,15 +153,19 @@ def main() -> None:
     tok_s = 1000.0 / ms_per_token
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                        V5E_PEAK_BF16_TFLOPS))
-    eff_bw_gbs = _decode_read_bytes(spec) / (ms_per_token / 1e3) / 1e9
+    eff_bw_gbs = (_decode_read_bytes(spec, avg_fill=fill + n_tokens / 2)
+                  / (ms_per_token / 1e3) / 1e9)
     mfu = _decode_flops(spec) * tok_s / (peak_tflops * 1e12)
 
+    metric = {"7b": "llama2_7b_q40_decode_ms_per_token_1chip",
+              "8b": "llama3_8b_q40_decode_ms_per_token_1chip"}.get(
+        model, "tiny_llama_q40_decode_ms_per_token")
+    base = BASELINE_8B_MS_PER_TOKEN if model == "8b" else BASELINE_MS_PER_TOKEN
     print(json.dumps({
-        "metric": f"llama2_7b_q40_decode_ms_per_token_1chip" if model == "7b"
-                  else "tiny_llama_q40_decode_ms_per_token",
+        "metric": metric,
         "value": round(ms_per_token, 3),
         "unit": "ms/token",
-        "vs_baseline": round(BASELINE_MS_PER_TOKEN / ms_per_token, 2),
+        "vs_baseline": round(base / ms_per_token, 2),
         "tokens_per_sec_per_chip": round(tok_s / n_chips, 2),
         "effective_hbm_gbs": round(eff_bw_gbs, 1),
         "mfu": round(mfu, 4),

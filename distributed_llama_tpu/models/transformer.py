@@ -80,8 +80,11 @@ class KVCache(NamedTuple):
 def _to_cache_dtype(x, dtype):
     """Cast k/v to the cache dtype; sub-bf16 caches (fp8 e4m3) saturate at
     the format's max first — the jax cast is non-saturating and |v| > 448
-    would become NaN, permanently poisoning every later attention read."""
-    if jnp.dtype(dtype).itemsize < 2:
+    would become NaN, permanently poisoning every later attention read
+    (read-side counterpart: ops/attention.is_narrow_cache)."""
+    from ..ops.attention import is_narrow_cache
+
+    if is_narrow_cache(dtype):
         lim = float(jnp.finfo(dtype).max)
         x = jnp.clip(x, -lim, lim)
     return x.astype(dtype)

@@ -16,6 +16,14 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def is_narrow_cache(dtype) -> bool:
+    """True for sub-bf16 KV-cache dtypes (the fp8 option). The contract:
+    writes saturate then narrow (models/transformer._to_cache_dtype), reads
+    upcast k/v at the dot operand so q and the softmax state never drop
+    below the compute dtype (here and in ops/pallas_attention.py)."""
+    return jnp.dtype(dtype).itemsize < 2
+
+
 def decode_attention(
     q: jnp.ndarray,        # (B, T, H, hs) — rotated queries
     k_cache: jnp.ndarray,  # (B, KVH, S, hs) — cache already updated at query positions
@@ -39,7 +47,7 @@ def decode_attention(
     # (S, hs) panel reads sequentially. Sub-bf16 caches (the fp8 option —
     # half the cache bytes) upcast at the dot operand, where XLA fuses the
     # convert into the read; q/probs never narrow below the compute dtype.
-    if jnp.dtype(k_cache.dtype).itemsize < 2:
+    if is_narrow_cache(k_cache.dtype):
         k_cache = k_cache.astype(q.dtype)
         v_cache = v_cache.astype(q.dtype)
     qg = q.reshape(b, t, kvh, group, hs)

@@ -122,7 +122,9 @@ def flash_decode_attention(
     # promote); compute dtype and cache dtype may differ. Wider caches
     # (f32) lift q; narrower caches (fp8) are lifted per-block in-kernel —
     # q and the softmax state never drop below the compute dtype
-    if jnp.dtype(k_cache.dtype).itemsize >= 2:
+    from .attention import is_narrow_cache
+
+    if not is_narrow_cache(k_cache.dtype):
         q = q.astype(k_cache.dtype)
     qh = q.reshape(b, kvh, g, hs).reshape(b * kvh, g, hs)
     kh = k_cache.reshape(b * kvh, s, hs)
