@@ -18,12 +18,13 @@ already-transformed params, so this feeds Engine(...) directly.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..io.model_file import HostTensor, iter_model_tensors
 from ..quants.jax_codec import QuantizedTensor
@@ -134,7 +135,9 @@ class _Placer:
             if moe_ep:
                 from ..parallel.ep_moe import EpRowWeight
 
-                arr = self._put(x, _ep_row_pspec(x.ndim))
+                from ..parallel.ep_moe import ep_row_pspec
+
+                arr = self._put(x, ep_row_pspec(x.ndim))
                 return EpRowWeight(
                     arr.astype(self.dtype) if self.dtype == jnp.bfloat16
                     else arr)
@@ -146,11 +149,11 @@ class _Placer:
             return self._col_q40(packed, scales, ep=moe_ep)
         pk, sc = QuantizedTensor.host_layout(scales, packed)
         if moe_ep:
-            from ..parallel.ep_moe import EpRowWeight
+            from ..parallel.ep_moe import EpRowWeight, ep_row_pspec
 
             return EpRowWeight(QuantizedTensor(
-                self._put(pk, _ep_row_pspec(pk.ndim)),
-                self._put(sc, _ep_row_pspec(sc.ndim)),
+                self._put(pk, ep_row_pspec(pk.ndim)),
+                self._put(sc, ep_row_pspec(sc.ndim)),
             ))
         return QuantizedTensor(
             self._put(pk, _pspec_for(key, pk.ndim, True, "packed")),
@@ -183,10 +186,10 @@ class _Placer:
 
 
 def _col_stack_pspec(ndim: int, ep: bool = False):
-    from jax.sharding import PartitionSpec as P
+    if ep:  # EpColWeight layout — single source in parallel/ep_moe.py
+        from ..parallel.ep_moe import ep_col_pspec
 
-    if ep:  # (tp, E, d, ...): tp stack on tp, experts on ep
-        return P(TP_AXIS, EP_AXIS, *([None] * (ndim - 2)))
+        return ep_col_pspec(ndim)
     return P(TP_AXIS, *([None] * (ndim - 1)))
 
 
@@ -198,13 +201,8 @@ class _PpStacker:
     tensor — never the full-L restack the engine-side path pays."""
 
     def __init__(self, mesh, pp: int):
-        import functools
-
-        from jax.sharding import PartitionSpec as P
-
         self.mesh = mesh
         self.pp = pp
-        self._P = P
 
         @functools.partial(jax.jit, donate_argnums=0, static_argnums=3)
         def update(buf, row, stage, sharding):
@@ -222,7 +220,7 @@ class _PpStacker:
         self._zeros = zeros  # one jit each — cache hits per distinct shape
 
     def _row(self, buf, arr: np.ndarray, stage: int, inner_pspec, dtype):
-        sh = NamedSharding(self.mesh, self._P(PP_AXIS, *inner_pspec))
+        sh = NamedSharding(self.mesh, P(PP_AXIS, *inner_pspec))
         if buf is None:
             buf = self._zeros((self.pp,) + arr.shape, jnp.dtype(dtype), sh)
         return self._update(buf, jnp.asarray(arr), stage, sh)
@@ -250,12 +248,6 @@ class _PpStacker:
             self._row(old.scales if old is not None else None, sc, stage,
                       _pspec_for(key, sc.ndim, True, "scales"), sc.dtype),
         ))
-
-
-def _ep_row_pspec(ndim: int):
-    from jax.sharding import PartitionSpec as P
-
-    return P(EP_AXIS, TP_AXIS, *([None] * (ndim - 2)))
 
 
 def _fuse_group(key: str) -> str | None:

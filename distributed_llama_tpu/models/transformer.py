@@ -240,13 +240,11 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
             top_idx,
         ].set(weights)
 
-    from ..parallel.ep_moe import EpRowWeight
+    from ..parallel.ep_moe import EpRowWeight, ep_moe_ffn
 
     if isinstance(lw["moe_up"], EpRowWeight):
         # expert-parallel placement (ep mesh axis): each ep shard computes
         # only its local experts, masked by the scattered routing weights
-        from ..parallel.ep_moe import ep_moe_ffn
-
         e_weights = scatter_weights()
         return ep_moe_ffn(
             xb, e_weights, lw, cfg["tp_mesh"],

@@ -73,24 +73,29 @@ def repack_moe_ep(lw: dict, tp: int) -> dict:
     return out
 
 
-def _row_pspec(w: EpRowWeight) -> EpRowWeight:
-    def spec(ndim):  # (E, d, m/nb/n): E -> ep, d -> tp
-        return P(EP_AXIS, TP_AXIS, *([None] * (ndim - 2)))
+def ep_row_pspec(ndim: int) -> P:
+    """(E, d, m/nb/n): experts -> ep, output rows -> tp. The single source
+    of the EpRowWeight layout (the streamed loader places with it too)."""
+    return P(EP_AXIS, TP_AXIS, *([None] * (ndim - 2)))
 
+
+def ep_col_pspec(ndim: int) -> P:
+    """(tp, E, d, ...): tp stack -> tp, experts -> ep (EpColWeight layout)."""
+    return P(TP_AXIS, EP_AXIS, *([None] * (ndim - 2)))
+
+
+def _row_pspec(w: EpRowWeight) -> EpRowWeight:
     if isinstance(w.w, QuantizedTensor):
-        return EpRowWeight(QuantizedTensor(spec(w.w.packed.ndim),
-                                           spec(w.w.scales.ndim)))
-    return EpRowWeight(spec(w.w.ndim))
+        return EpRowWeight(QuantizedTensor(ep_row_pspec(w.w.packed.ndim),
+                                           ep_row_pspec(w.w.scales.ndim)))
+    return EpRowWeight(ep_row_pspec(w.w.ndim))
 
 
 def _col_pspec(w: EpColWeight) -> EpColWeight:
-    def spec(ndim):  # (tp, E, d, ...): tp stack -> tp, E -> ep
-        return P(TP_AXIS, EP_AXIS, *([None] * (ndim - 2)))
-
     if isinstance(w.w, QuantizedTensor):
-        return EpColWeight(QuantizedTensor(spec(w.w.packed.ndim),
-                                           spec(w.w.scales.ndim)))
-    return EpColWeight(spec(w.w.ndim))
+        return EpColWeight(QuantizedTensor(ep_col_pspec(w.w.packed.ndim),
+                                           ep_col_pspec(w.w.scales.ndim)))
+    return EpColWeight(ep_col_pspec(w.w.ndim))
 
 
 def ep_pspec(w):
