@@ -23,6 +23,7 @@ import json
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
+import jax
 import numpy as np
 
 CHAT_EOS_MARKERS = ("<|eot_id|>", "<|end_of_text|>")
@@ -92,7 +93,7 @@ def _completion_chunks(state: ApiState, body: dict):
     try:
         logits = engine.prefill(tokens)
         for _ in range(n_gen):
-            tok = sampler.sample(np.asarray(logits)[0])
+            tok = sampler.sample(engine.fetch_logits(logits)[0])
             if tok == tokenizer.eos_id:
                 finish = "stop"
                 break
@@ -161,6 +162,14 @@ def make_handler(state: ApiState):
             created = int(time.time())
             stream = bool(body.get("stream", False))
 
+            multihost = jax.process_count() > 1
+            if multihost:
+                # multi-host cluster: workers replay this exact request from
+                # the raw body (apps/dllama.py cmd_worker); broadcast before
+                # any engine work so their collectives line up with ours
+                from ..parallel import multihost as mh
+                mh.send_api(json.dumps(body).encode())
+
             # pull the first event before committing a 200 so prompt errors
             # can still return a clean 4xx
             gen = _completion_chunks(state, body)
@@ -173,6 +182,14 @@ def make_handler(state: ApiState):
             def events():
                 yield first
                 yield from gen
+
+            def drain():
+                # multi-host: workers replay the FULL request; if this
+                # handler aborts mid-stream (client disconnect), finish the
+                # engine steps anyway so cross-host collectives stay aligned
+                if multihost:
+                    for _ in gen:
+                        pass
 
             if stream:
                 # SSE chunked streaming (ref: dllama-api.cpp:125-145,183-200)
@@ -187,15 +204,18 @@ def make_handler(state: ApiState):
                     self.wfile.flush()
 
                 usage = None
-                for kind, payload in events():
-                    if kind == "piece":
-                        sse({"id": rid, "object": "chat.completion.chunk",
-                             "created": created, "model": state.model_name,
-                             "choices": [{"index": 0,
-                                          "delta": {"content": payload},
-                                          "finish_reason": None}]})
-                    else:
-                        usage = payload
+                try:
+                    for kind, payload in events():
+                        if kind == "piece":
+                            sse({"id": rid, "object": "chat.completion.chunk",
+                                 "created": created, "model": state.model_name,
+                                 "choices": [{"index": 0,
+                                              "delta": {"content": payload},
+                                              "finish_reason": None}]})
+                        else:
+                            usage = payload
+                finally:
+                    drain()
                 sse({"id": rid, "object": "chat.completion.chunk",
                      "created": created, "model": state.model_name,
                      "choices": [{"index": 0, "delta": {},
@@ -206,11 +226,14 @@ def make_handler(state: ApiState):
 
             text = ""
             usage = {"finish_reason": "length", "prompt_tokens": 0, "completion_tokens": 0}
-            for kind, payload in events():
-                if kind == "piece":
-                    text += payload
-                else:
-                    usage = payload
+            try:
+                for kind, payload in events():
+                    if kind == "piece":
+                        text += payload
+                    else:
+                        usage = payload
+            finally:
+                drain()
             # OpenAI-shaped response + usage (ref: types.hpp:10-91)
             self._json(200, {
                 "id": rid, "object": "chat.completion", "created": created,

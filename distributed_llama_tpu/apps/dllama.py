@@ -8,9 +8,13 @@ TPU-native equivalent of the reference CLI (ref: src/apps/dllama/dllama.cpp):
   chat       interactive chat with the Llama-2 [INST]/<<SYS>> template
              (ref: dllama.cpp:133-178)
   api        OpenAI-compatible HTTP server (ref: src/apps/dllama-api)
-  worker     n/a — the reference's root/worker TCP star is replaced by one
-             SPMD program over a jax Mesh; use --tp N instead
-             (ref: dllama.cpp:180-193, SURVEY.md §5.8)
+  worker     join a multi-host cluster as a non-root process
+             (ref: dllama.cpp:180-193). Single-host multi-device needs no
+             workers — use --tp N. Across hosts, start workers with
+             `dllama worker --nnodes N --node-rank r --coordinator h:p`
+             and the root with the same --nnodes/--coordinator plus any
+             mode; the mesh then spans every host's devices and workers
+             follow the broadcast protocol (parallel/multihost.py)
 
 Flag surface mirrors AppArgs::parse (ref: src/app.cpp:19-93) plus TPU mesh
 flags. --weights-float-type / --buffer-float-type keep the reference
@@ -86,6 +90,16 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="force the XLA dequant path instead of the Pallas "
                         "kernels")
     p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
+    # multi-host cluster flags (the reference's root + worker nodes,
+    # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of host processes in the cluster (rank 0 is "
+                        "the root; others run `dllama worker`)")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this process's rank (0..nnodes-1)")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator address host:port, "
+                        "reachable from every node (required with --nnodes)")
     return p
 
 
@@ -118,10 +132,33 @@ def build_engine(args):
     kdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
            "f8": jnp.float8_e4m3fn}[args.cache_dtype]
 
+    multihost = jax.process_count() > 1
+    if multihost:
+        # every process must agree on the mesh/dtype flags (the reference
+        # memcpys its spec struct over the socket and hopes — we verify)
+        from ..parallel.multihost import check_config
+        check_config([args.tp, args.dp, args.sp, args.ep, args.pp,
+                      int(args.buffer_float_type == "q80"),
+                      int(args.compute_dtype == "bf16"),
+                      ["bf16", "f32", "f8"].index(args.cache_dtype),
+                      # a seq-len or kernel-path mismatch would compile
+                      # different step programs / loop bounds per process ->
+                      # a cross-host collective hang, not an error
+                      args.max_seq_len if args.max_seq_len is not None else -1,
+                      2 if args.pallas is None else int(args.pallas),
+                      # API-mode sampling uses each process's OWN sampler
+                      # flags (MSG_RUN headers carry them, MSG_API doesn't)
+                      # — a mismatch would silently diverge token streams
+                      int(np.float32(args.temperature).view(np.int32)),
+                      int(np.float32(args.topp).view(np.int32))])
+
     mesh = None
-    if args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1 or args.pp > 1:
+    if (args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1
+            or args.pp > 1 or multihost):
         from ..parallel.mesh import make_mesh
-        mesh = make_mesh(tp=args.tp, dp=args.dp, sp=args.sp, ep=args.ep,
+        # multihost with all-default axes: tp spans every device cluster-wide
+        tp = None if (multihost and args.tp == 1) else args.tp
+        mesh = make_mesh(tp=tp, dp=args.dp, sp=args.sp, ep=args.ep,
                          pp=args.pp)
 
     q80 = args.buffer_float_type == "q80"
@@ -151,6 +188,12 @@ def build_engine(args):
 
     tokenizer = Tokenizer.from_file(args.tokenizer)
     seed = args.seed if args.seed is not None else int(time.time())
+    if multihost:
+        # one sampler stream cluster-wide: every process reproduces the
+        # root's sampling decisions locally (no per-token control traffic,
+        # unlike the reference's per-step pos broadcast, tasks.cpp:165-182)
+        from ..parallel.multihost import broadcast_seed
+        seed = broadcast_seed(seed)
     sampler = Sampler(tokenizer.vocab_size, args.temperature, args.topp, seed)
     return engine, tokenizer, sampler
 
@@ -166,6 +209,18 @@ def _safe_print(piece: str) -> None:
     print(out, end="", flush=True)
 
 
+def _announce_run(tokens: list[int], max_tokens: int, reset: bool = False,
+                  sampler=None) -> None:
+    """Root side of the multi-host protocol: tell worker processes to enter
+    the same generate() call (no-op single-process)."""
+    if jax.process_count() > 1:
+        from ..parallel import multihost as mh
+        mh.send_run(tokens, max_tokens,
+                    sampler.rng_state if sampler else 0,
+                    sampler.temperature if sampler else 0.0,
+                    sampler.topp if sampler else 0.0, reset)
+
+
 def cmd_generate(args, benchmark: bool) -> None:
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
@@ -176,6 +231,7 @@ def cmd_generate(args, benchmark: bool) -> None:
         # dp throughput mode: the batch rows generate independently (here the
         # same prompt replicated); row 0 streams to stdout
         t0 = time.time()
+        _announce_run(tokens, _steps(args, engine), sampler=sampler)
         outs = engine.generate_batch([tokens] * engine.batch,
                                      _steps(args, engine), sampler,
                                      eos_id=tokenizer.stop_token_ids())
@@ -198,6 +254,7 @@ def cmd_generate(args, benchmark: bool) -> None:
         _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
         prev[0] = tok
 
+    _announce_run(tokens, _steps(args, engine), sampler=sampler)
     res = engine.generate(tokens, _steps(args, engine), sampler,
                           eos_id=tokenizer.stop_token_ids(), on_token=on_token)
     print()
@@ -206,6 +263,9 @@ def cmd_generate(args, benchmark: bool) -> None:
         # S = modeled per-device collective kB, T = measured all-reduce
         # microbench scaled to the per-layer reduce count (netstats.py)
         wire = engine.wire_estimate()
+        if jax.process_count() > 1:
+            from ..parallel import multihost as mh
+            mh.send_xfer_bench()  # workers join the collective microbench
         t_ms = engine.measure_transfer_ms()
         for i, s in enumerate(res.stats.steps):
             print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
@@ -261,9 +321,63 @@ def cmd_chat(args) -> None:
         if remaining <= 1:
             print("(context window full)")
             break
+        _announce_run(tokens, min(_steps(args, engine), remaining),
+                      sampler=sampler)
         engine.generate(tokens, min(_steps(args, engine), remaining), sampler,
                         eos_id=stops, on_token=on_token)
         print()
+
+
+def cmd_worker(args) -> None:
+    """Worker process: hold this host's weight shards, lock-step the root's
+    runs (ref: src/apps/dllama/dllama.cpp:180-193, Worker::work
+    tasks.cpp:230-256 — the TaskLoop pass per `pos` trigger becomes a full
+    generate() per broadcast run; per-token sync is unnecessary because the
+    sampler stream is deterministic and logits are replicated)."""
+    from ..parallel import multihost as mh
+
+    engine, tokenizer, sampler = build_engine(args)
+    stops = tokenizer.stop_token_ids()
+    api_state = None
+    print(f"⏳ worker rank {jax.process_index()} of {jax.process_count()} "
+          "ready")
+    while True:
+        msg = mh.recv_msg()
+        if msg.kind == mh.MSG_SHUTDOWN:
+            print("🔌 root shut down — exiting")
+            return
+        if msg.kind == mh.MSG_RUN:
+            if msg.reset:
+                engine.reset()
+            # sample with the ROOT's params and rng state from the header —
+            # immune to any sampler-flag mismatch between the processes
+            from ..sampler import Sampler
+            run_sampler = Sampler(tokenizer.vocab_size, msg.temperature,
+                                  msg.topp, msg.seed)
+            if engine.batch > 1:
+                engine.generate_batch([msg.tokens] * engine.batch,
+                                      msg.max_tokens, run_sampler,
+                                      eos_id=stops)
+            else:
+                engine.generate(msg.tokens, msg.max_tokens, run_sampler,
+                                eos_id=stops)
+        elif msg.kind == mh.MSG_API:
+            # replay the root's API request end-to-end from the raw body —
+            # prompt build, sampling, stop scan are all deterministic
+            import json
+
+            from .api_server import ApiState, _completion_chunks
+            if api_state is None:
+                api_state = ApiState(engine, tokenizer, sampler)
+            try:
+                for _ in _completion_chunks(api_state, json.loads(msg.body)):
+                    pass
+            except Exception as e:  # noqa: BLE001 — a bad request must not
+                # kill the worker while the root's HTTP server lives on; the
+                # root raised the same deterministic error at the same point
+                print(f"⚠️  request failed: {type(e).__name__}: {e}")
+        elif msg.kind == mh.MSG_XFER_BENCH:
+            engine.measure_transfer_ms()
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -271,19 +385,37 @@ def main(argv: list[str] | None = None) -> None:
     if args.workers:
         sys.exit("error: --workers is not applicable on TPU — the reference's "
                  "TCP root/worker star is one SPMD program here; use --tp N "
-                 "to shard over N devices (SURVEY.md §5.8)")
-    if args.mode == "worker":
-        sys.exit("error: worker mode is not applicable on TPU — run a single "
-                 "process with --tp N over the device mesh instead")
-    if args.mode == "inference":
-        cmd_generate(args, benchmark=True)
-    elif args.mode == "generate":
-        cmd_generate(args, benchmark=False)
-    elif args.mode == "chat":
-        cmd_chat(args)
-    elif args.mode == "api":
-        from .api_server import serve
-        serve(args)
+                 "for one host's devices, or --nnodes/--coordinator + "
+                 "`dllama worker` processes for a multi-host cluster")
+    if args.nnodes > 1:
+        if not args.coordinator:
+            sys.exit("error: --nnodes > 1 requires --coordinator host:port")
+        if args.mode == "worker" and args.node_rank == 0:
+            sys.exit("error: rank 0 is the root — run a non-worker mode")
+        if args.mode != "worker" and args.node_rank != 0:
+            sys.exit("error: non-root ranks must run `dllama worker`")
+        from ..parallel.multihost import init_multihost
+        init_multihost(args.coordinator, args.nnodes, args.node_rank)
+    elif args.mode == "worker":
+        sys.exit("error: worker mode needs a cluster — pass --nnodes N "
+                 "--node-rank r --coordinator host:port (single-host "
+                 "multi-device runs need no workers: use --tp N)")
+    try:
+        if args.mode == "worker":
+            cmd_worker(args)
+        elif args.mode == "inference":
+            cmd_generate(args, benchmark=True)
+        elif args.mode == "generate":
+            cmd_generate(args, benchmark=False)
+        elif args.mode == "chat":
+            cmd_chat(args)
+        elif args.mode == "api":
+            from .api_server import serve
+            serve(args)
+    finally:
+        if args.nnodes > 1 and args.mode != "worker":
+            from ..parallel import multihost as mh
+            mh.send_shutdown()
 
 
 if __name__ == "__main__":

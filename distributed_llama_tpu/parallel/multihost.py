@@ -1,0 +1,200 @@
+"""Multi-host execution — the reference's root/worker cluster, TPU-native.
+
+The reference scales across machines with a hand-rolled TCP star: one root
+process drives generation while N workers each hold a weight shard and
+lock-step the per-token task list, triggered by a `pos` broadcast
+(ref: src/apps/dllama/dllama.cpp:180-193, src/tasks.cpp:165-182,
+src/socket.cpp). Here the cluster is `jax.distributed`: every host runs the
+same SPMD program over ONE global `Mesh` whose devices span processes; XLA
+routes the collectives over ICI within a slice and DCN across hosts.
+
+Process 0 ("root", the reference's root node) does the tokenize / sample /
+print / HTTP I/O. Worker processes (`dllama worker --nnodes N --node-rank
+r --coordinator host:port`) join the mesh and follow a small broadcast
+protocol carrying exactly what the reference root pushed over its sockets
+each run: the prompt tokens, step budget, and sampling params
+(ref: src/apps/dllama/dllama.cpp:180-193). Generation itself then needs NO
+per-token control traffic: logits are replicated to every host by the jitted
+step, and the sampler is a deterministic xorshift stream whose state rides
+the run header — each host locally reproduces the root's token choices,
+where the reference had to broadcast `pos` every step.
+
+Framing: every root->worker message is one fixed-size int64 header
+broadcast, optionally followed by one payload broadcast whose length the
+header announced. Uniform framing means a root that dies or exits at ANY
+protocol point pairs its final SHUTDOWN header with whatever header read a
+worker is blocked in — workers always shut down cleanly instead of
+deadlocking in a shape-mismatched collective.
+
+Weights: every host streams only its addressable shards from its own copy
+of the `.m` file (models/loader.py places per-device shards) — the
+equivalent of the reference root pushing each worker its slice over TCP at
+startup (ref: src/transformer.cpp:562-621), minus the network hop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+# message kinds (root -> workers)
+MSG_SHUTDOWN = 0
+MSG_RUN = 1       # one engine.generate(): tokens + budget + sampling params
+MSG_API = 2       # one API request: raw JSON body bytes
+MSG_XFER_BENCH = 3  # join a measure_transfer_ms() collective microbench
+MSG_SEED = 5      # startup handshake: cluster-wide sampler seed
+
+# [kind, n_payload, payload_is_bytes, max_tokens, seed_lo, seed_hi,
+#  temp_bits, topp_bits, reset]
+_HEADER_LEN = 9
+
+
+def init_multihost(coordinator: str, num_processes: int, process_id: int) -> int:
+    """Join the jax.distributed cluster; returns this process's index.
+
+    Call before any JAX backend use. Every process must pass the same
+    coordinator address ("host:port", reachable from all hosts) and the
+    cluster size; ranks are 0..num_processes-1 with rank 0 the root.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()
+
+
+def is_multihost(mesh) -> bool:
+    """Does this mesh span more than one process? (If so, engine outputs
+    must be replicated before a host fetch, and host-side drivers must run
+    the broadcast protocol.)"""
+    if mesh is None:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _bcast(arr: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+
+
+class RunMsg:
+    """One decoded protocol message."""
+
+    def __init__(self, kind: int, tokens=None, body: bytes | None = None,
+                 ints=None, max_tokens: int = 0, seed: int = 0,
+                 temperature: float = 0.0, topp: float = 0.0,
+                 reset: bool = False):
+        self.kind = kind
+        self.tokens = tokens
+        self.body = body
+        self.ints = ints
+        self.max_tokens = max_tokens
+        self.seed = seed
+        self.temperature = temperature
+        self.topp = topp
+        self.reset = reset
+
+
+def _send(kind: int, *, int_payload=None, bytes_payload: bytes | None = None,
+          max_tokens: int = 0, seed: int = 0, temperature: float = 0.0,
+          topp: float = 0.0, reset: bool = False) -> None:
+    assert int_payload is None or bytes_payload is None
+    n = (len(int_payload) if int_payload is not None
+         else len(bytes_payload) if bytes_payload is not None else 0)
+    header = [
+        kind, n, int(bytes_payload is not None), max_tokens,
+        seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF,
+        int(np.float32(temperature).view(np.int32)),
+        int(np.float32(topp).view(np.int32)),
+        int(reset),
+    ]
+    _bcast(np.asarray(header, np.int64))
+    if int_payload is not None:
+        _bcast(np.asarray(int_payload, np.int64))
+    elif bytes_payload is not None:
+        _bcast(np.frombuffer(bytes_payload, np.uint8))
+
+
+def recv_msg() -> RunMsg:
+    """Worker: block for the next protocol message."""
+    h = _bcast(np.zeros(_HEADER_LEN, np.int64))
+    kind, n, is_bytes = int(h[0]), int(h[1]), int(h[2])
+    msg = RunMsg(
+        kind,
+        max_tokens=int(h[3]),
+        seed=int(h[4]) | (int(h[5]) << 32),
+        temperature=float(np.int32(h[6]).view(np.float32)),
+        topp=float(np.int32(h[7]).view(np.float32)),
+        reset=bool(h[8]),
+    )
+    if n:
+        if is_bytes:
+            msg.body = _bcast(np.zeros(n, np.uint8)).tobytes()
+        else:
+            msg.ints = [int(v) for v in _bcast(np.zeros(n, np.int64))]
+            if kind == MSG_RUN:
+                msg.tokens = msg.ints
+    return msg
+
+
+# -- root-side senders -----------------------------------------------------
+
+def send_run(tokens: list[int], max_tokens: int, seed: int,
+             temperature: float, topp: float, reset: bool = False) -> None:
+    """Root: announce one generate() run. seed carries the root sampler's
+    CURRENT rng state, so workers reproduce the token stream even when
+    their own sampler flags differ."""
+    _send(MSG_RUN, int_payload=tokens, max_tokens=max_tokens, seed=seed,
+          temperature=temperature, topp=topp, reset=reset)
+
+
+def send_api(body_json: bytes) -> None:
+    """Root: announce one API request; workers replay the identical
+    completion loop from the raw request body."""
+    _send(MSG_API, bytes_payload=body_json)
+
+
+def send_xfer_bench() -> None:
+    _send(MSG_XFER_BENCH)
+
+
+def send_shutdown() -> None:
+    _send(MSG_SHUTDOWN)
+
+
+# -- startup handshake -----------------------------------------------------
+
+def check_config(fingerprint: list[int]) -> None:
+    """Verify every process launched with the same mesh/dtype/sampler config
+    (the reference ships its spec as a raw struct memcpy and is silently
+    ABI-fragile — ref: src/transformer.cpp:633). All-gathered so EVERY rank
+    sees every other rank's fingerprint: a mismatch errors symmetrically and
+    immediately on all processes, instead of one side exiting while the
+    other hangs in its next collective."""
+    from jax.experimental import multihost_utils
+
+    mine = np.asarray(fingerprint, np.int64)
+    allfp = np.asarray(multihost_utils.process_allgather(mine))
+    bad = [r for r in range(allfp.shape[0]) if list(allfp[r]) != list(allfp[0])]
+    if bad:
+        raise SystemExit(
+            f"cluster config mismatch: rank 0 has {list(allfp[0])}, "
+            f"rank(s) {bad} differ (mine: {list(mine)}) — every process "
+            "must use the same --tp/--dp/--sp/--ep/--pp, dtype, seq-len, "
+            "pallas and sampler flags")
+
+
+def broadcast_seed(seed: int) -> int:
+    """Agree on one base sampler seed cluster-wide (the CLI default is
+    time-based, which would diverge per host)."""
+    if jax.process_index() == 0:
+        _send(MSG_SEED, seed=seed)
+        return seed
+    msg = recv_msg()
+    if msg.kind == MSG_SHUTDOWN:
+        raise SystemExit("root shut down during startup")
+    if msg.kind != MSG_SEED:
+        raise SystemExit(f"protocol error: expected seed, got kind={msg.kind}")
+    return msg.seed

@@ -179,6 +179,14 @@ class Engine:
             self._cache_sharding = None
             self._token_sharding = None
 
+        # mesh spanning >1 process (jax.distributed): host code may only
+        # fetch fully-replicated arrays, so logits are all-gathered to every
+        # host before sampling (parallel/multihost.py)
+        from ..parallel.multihost import is_multihost
+
+        self._multihost = is_multihost(mesh)
+        self._replicator = None
+
         self._cache_maker = None
         self.cache = self._new_cache()
         self.pos = 0
@@ -316,6 +324,19 @@ class Engine:
         self.pos = pos0 + t
         return logits
 
+    def fetch_logits(self, logits: jax.Array) -> np.ndarray:
+        """Bring step() logits to the host. On a multi-process mesh the
+        array may be sharded over non-addressable devices; replicate first
+        (every host then samples the same logits — the protocol's
+        lock-step invariant, parallel/multihost.py)."""
+        if self._multihost and not logits.is_fully_replicated:
+            if self._replicator is None:
+                self._replicator = jax.jit(
+                    lambda l: l,
+                    out_shardings=NamedSharding(self.mesh, P()))
+            logits = self._replicator(logits)
+        return np.asarray(logits)
+
     # -- generation -------------------------------------------------------
 
     def prefill(self, prompt: list[int]) -> jax.Array:
@@ -377,7 +398,7 @@ class Engine:
 
         t0 = time.perf_counter()
         logits = self.prefill(prompt)
-        logits_np = np.asarray(logits)  # device->host transfer is the only true sync on tunneled platforms
+        logits_np = self.fetch_logits(logits)  # D2H is the only true sync on tunneled platforms
         t1 = time.perf_counter()
         stats.add(StepStats(generation_ms=(t1 - t0) * 1e3, device_ms=(t1 - t0) * 1e3))
 
@@ -391,7 +412,7 @@ class Engine:
                 break
             g0 = time.perf_counter()
             logits = self.step(np.asarray([[token]], np.int32), self.pos)
-            logits_np = np.asarray(logits)
+            logits_np = self.fetch_logits(logits)
             g1 = time.perf_counter()
             token = sampler.sample(logits_np[0])
             g2 = time.perf_counter()
@@ -443,7 +464,7 @@ class Engine:
             tok = jax.device_put(tok, self._token_sharding)
         logits, self.cache = pre_fn(
             self.params, tok, jnp.asarray(lens - 1), self.cache)
-        logits_np = np.asarray(logits)
+        logits_np = self.fetch_logits(logits)
 
         out: list[list[int]] = [[] for _ in range(b)]
         done = np.zeros(b, bool)
@@ -473,7 +494,7 @@ class Engine:
                     posv, NamedSharding(self.mesh, P(DP_AXIS)))
             logits, self.cache = vec_fn(
                 self.params, tokv, posv, self.cache)
-            logits_np = np.asarray(logits)
+            logits_np = self.fetch_logits(logits)
             for i in range(b):
                 if not alive(i):
                     continue
