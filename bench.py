@@ -4,7 +4,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 `vs_baseline` is the speedup over the reference's best published
 single-node number for the benched model: Llama-2-7B = 101.81 ms/token
 (30-vCPU GCP c3d, ref README.md:88), Llama-3-8B = 564.31 ms/token
-(RasPi 5, ref README.md:61).
+(RasPi 5, ref README.md:61), Llama-2-13B = 184.19 ms/token (GCP c3d,
+ref README.md:89).
 
 Weights are synthetic Q40 blocks generated at the packed-byte level (random
 nibbles + small f16 scales) — decode speed does not depend on weight values,
@@ -12,9 +13,10 @@ and this avoids materializing 28 GB of f32 on the host. The decode path is
 the production one: Engine.decode_greedy_device (fully on-device lax.scan,
 fused argmax, donated KV cache).
 
-Env knobs: BENCH_MODEL=7b|8b|tiny (8b = Llama-3-8B GQA/128k-vocab, judged
-against the reference's best 1-node 8B number), BENCH_TOKENS=<n decode
-steps>, BENCH_SEQ/BENCH_FILL for long-context variants.
+Env knobs: BENCH_MODEL=7b|8b|13b|tiny (8b = Llama-3-8B GQA/128k-vocab,
+judged against the reference's best 1-node 8B number; 13b vs its 13B GCP
+row), BENCH_TOKENS=<n decode steps>, BENCH_SEQ/BENCH_FILL for long-context
+variants.
 """
 
 from __future__ import annotations
@@ -33,10 +35,16 @@ from distributed_llama_tpu.runtime.engine import Engine
 
 BASELINE_MS_PER_TOKEN = 101.81  # ref README.md:88 — Llama 2 7B, 1x GCP c3d-highcpu-30
 BASELINE_8B_MS_PER_TOKEN = 564.31  # ref README.md:61 — Llama 3 8B, best 1-node (RasPi 5)
+BASELINE_13B_MS_PER_TOKEN = 184.19  # ref README.md:89 — Llama 2 13B, 1x GCP c3d-highcpu-30
 
 LLAMA2_7B = ModelSpec(
     arch=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
     n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
+    hidden_act=HiddenAct.SILU)
+
+LLAMA2_13B = ModelSpec(  # 7.2 GB packed Q40 — fits one 16 GB chip
+    arch=ArchType.LLAMA, dim=5120, hidden_dim=13824, n_layers=40,
+    n_heads=40, n_kv_heads=40, vocab_size=32000, seq_len=2048,
     hidden_act=HiddenAct.SILU)
 
 LLAMA3_8B = ModelSpec(  # GQA + 128k vocab (BASELINE.json config 2)
@@ -122,7 +130,8 @@ def main() -> None:
     # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
-    spec = {"7b": LLAMA2_7B, "8b": LLAMA3_8B}.get(model, TINY)
+    spec = {"7b": LLAMA2_7B, "8b": LLAMA3_8B,
+            "13b": LLAMA2_13B}.get(model, TINY)
     # long-context variants: BENCH_SEQ widens the cache, BENCH_FILL starts
     # decode at a deep fill (the flash kernel reads ~fill bytes of cache)
     seq = int(os.environ.get("BENCH_SEQ", str(min(spec.seq_len, 2048))))
@@ -158,9 +167,12 @@ def main() -> None:
     mfu = _decode_flops(spec) * tok_s / (peak_tflops * 1e12)
 
     metric = {"7b": "llama2_7b_q40_decode_ms_per_token_1chip",
-              "8b": "llama3_8b_q40_decode_ms_per_token_1chip"}.get(
+              "8b": "llama3_8b_q40_decode_ms_per_token_1chip",
+              "13b": "llama2_13b_q40_decode_ms_per_token_1chip"}.get(
         model, "tiny_llama_q40_decode_ms_per_token")
-    base = BASELINE_8B_MS_PER_TOKEN if model == "8b" else BASELINE_MS_PER_TOKEN
+    base = {"8b": BASELINE_8B_MS_PER_TOKEN,
+            "13b": BASELINE_13B_MS_PER_TOKEN}.get(
+        model, BASELINE_MS_PER_TOKEN)
     print(json.dumps({
         "metric": metric,
         "value": round(ms_per_token, 3),

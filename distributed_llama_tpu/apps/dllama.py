@@ -90,6 +90,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="force the XLA dequant path instead of the Pallas "
                         "kernels")
     p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the generation to DIR "
+                        "(view with tensorboard/xprof; net-new — the "
+                        "reference has no profiler hooks, SURVEY.md §5.1)")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -221,6 +225,21 @@ def _announce_run(tokens: list[int], max_tokens: int, reset: bool = False,
                     sampler.topp if sampler else 0.0, reset)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _maybe_profile(args):
+    """jax.profiler trace of the generation when --profile DIR is given."""
+    if not args.profile:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.trace(args.profile):
+        yield
+    print(f"📈 profiler trace written to {args.profile}")
+
+
 def cmd_generate(args, benchmark: bool) -> None:
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
@@ -255,8 +274,10 @@ def cmd_generate(args, benchmark: bool) -> None:
         prev[0] = tok
 
     _announce_run(tokens, _steps(args, engine), sampler=sampler)
-    res = engine.generate(tokens, _steps(args, engine), sampler,
-                          eos_id=tokenizer.stop_token_ids(), on_token=on_token)
+    with _maybe_profile(args):
+        res = engine.generate(tokens, _steps(args, engine), sampler,
+                              eos_id=tokenizer.stop_token_ids(),
+                              on_token=on_token)
     print()
     if benchmark:
         # per-token G/I/T/S lines + averages (ref: dllama.cpp:47-48,74-91);

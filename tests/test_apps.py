@@ -151,3 +151,19 @@ def test_api_bad_json(api_server):
     conn.request("POST", "/v1/chat/completions", "{not json",
                  {"Content-Type": "application/json"})
     assert conn.getresponse().status == 400
+
+
+def test_cli_profile_flag(tmp_path, rng, capsys):
+    """--profile DIR writes a jax.profiler trace of the generation
+    (net-new observability; the reference has no profiler hooks)."""
+    import os
+
+    mpath, tpath = _fixture(tmp_path, rng)
+    pdir = str(tmp_path / "trace")
+    dllama.main(["generate", "--model", mpath, "--tokenizer", tpath,
+                 "--prompt", "ab", "--steps", "2", "--seed", "7",
+                 "--temperature", "0", "--profile", pdir])
+    out = capsys.readouterr().out
+    assert "profiler trace written" in out
+    found = [f for _, _, fs in os.walk(pdir) for f in fs]
+    assert any(f.endswith((".pb", ".json.gz", ".xplane.pb")) for f in found), found

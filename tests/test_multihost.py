@@ -34,7 +34,7 @@ WRAPPER = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
 def _fixture(tmp_path):
     spec = ModelSpec(
         arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
-        n_kv_heads=2, vocab_size=288, seq_len=96, hidden_act=HiddenAct.SILU,
+        n_kv_heads=2, vocab_size=288, seq_len=160, hidden_act=HiddenAct.SILU,
         weights_float_type=FloatType.Q40)
     rng = np.random.default_rng(77)
     tensors = {name: rng.standard_normal(shape).astype(np.float32) * 0.05
@@ -103,6 +103,91 @@ def test_two_process_cluster_matches_single(tmp_path):
         out_root, out_single)
     assert "worker rank 1 of 2 ready" in out_worker
     assert "root shut down" in out_worker
+
+
+def _post_completion(port: int, body: dict, deadline: float = 240.0) -> dict:
+    """POST /v1/chat/completions, retrying until the server accepts."""
+    import http.client
+    import json
+    import time
+
+    t0 = time.time()
+    last = None
+    while time.time() - t0 < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = json.loads(resp.read())
+            conn.close()
+            return data
+        except (ConnectionRefusedError, OSError) as e:
+            last = e
+            time.sleep(1.0)
+    raise TimeoutError(f"server never came up: {last}")
+
+
+def _stop(proc) -> tuple[str, str]:
+    """Terminate a server/worker subprocess, escalating to SIGKILL (the api
+    root blocks in serve_forever; workers may be blocked in a collective).
+    Drains and returns (stdout, stderr) so failures carry diagnostics and
+    the pipes can't fill up or leak."""
+    proc.terminate()
+    try:
+        return proc.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.communicate(timeout=10)
+
+
+def test_two_process_cluster_api_mode(tmp_path):
+    """api mode over a 2-process cluster: the worker replays each request
+    from its broadcast JSON body; the completion must equal the
+    single-process server's."""
+    mpath, tpath = _fixture(tmp_path)
+    body = {"messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5, "temperature": 0}
+
+    def run_api(extra, http_port):
+        # f32 buffers: default q80 would give the tp=2 cluster lossy
+        # quantized reduces vs the single run's exact ones (same pinning as
+        # test_two_process_cluster_matches_single)
+        return _run(["api", "--model", mpath, "--tokenizer", tpath,
+                     "--temperature", "0", "--seed", "11",
+                     "--buffer-float-type", "f32",
+                     "--port", str(http_port), "--host", "127.0.0.1", *extra])
+
+    # single-process reference completion
+    port1 = _free_port()
+    single, _ = run_api([], port1)
+    try:
+        want = _post_completion(port1, body)
+    finally:
+        _, err = _stop(single)
+        print("single server stderr:", err[-2000:])  # shown on failure
+
+    # two-process cluster (root api + worker)
+    port2, cport = _free_port(), _free_port()
+    cluster = ["--nnodes", "2", "--coordinator", f"127.0.0.1:{cport}"]
+    root, _ = run_api([*cluster, "--node-rank", "0"], port2)
+    worker, _ = _run(["worker", "--model", mpath, "--tokenizer", tpath,
+                      "--temperature", "0", "--seed", "11",
+                      "--buffer-float-type", "f32",
+                      *cluster, "--node-rank", "1"])
+    try:
+        got = _post_completion(port2, body)
+        # same completion text and token accounting as the single server
+        assert (got["choices"][0]["message"]["content"]
+                == want["choices"][0]["message"]["content"]), (got, want)
+        assert got["usage"] == want["usage"], (got, want)
+    finally:
+        # the api server runs until killed; the worker exits via coordinator
+        # teardown when the root dies (or the SIGKILL escalation)
+        _, r_err = _stop(root)
+        _, w_err = _stop(worker)
+        print("root stderr:", r_err[-2000:])    # shown on failure
+        print("worker stderr:", w_err[-2000:])
 
 
 def test_worker_mode_requires_cluster_flags():

@@ -1,0 +1,51 @@
+"""Prefill chunk-size ladder: fused-kernel 256-chunks vs larger XLA-dequant
+segments on the real chip.
+
+The engine prefers prefill_chunk=256 (the Pallas MAX_T); segments above that
+take the XLA dequant path, which re-materializes bf16 weights per matmul but
+amortizes over more tokens. This measures tokens/sec for a 2048-token prompt
+at several chunk sizes to find the crossover (if any).
+
+Usage: python tools/exp_prefill_chunk.py [7b|tiny]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import bench
+from distributed_llama_tpu.runtime.engine import Engine
+
+PROMPT_LEN = 2048
+
+
+def run(model: str) -> None:
+    spec = bench.LLAMA2_7B if model == "7b" else bench.TINY
+    params = bench.synth_q40_params(spec)
+    tokens = np.ones((1, PROMPT_LEN), np.int32)
+
+    for chunk in (128, 256, 512, 1024, 2048):
+        engine = Engine(spec, params, compute_dtype=jnp.bfloat16,
+                        cache_dtype=jnp.bfloat16, max_seq_len=PROMPT_LEN,
+                        prefill_chunk=chunk)
+        best = 1e9
+        for rep in range(3):
+            engine.reset()
+            t0 = time.perf_counter()
+            logits = engine.prefill(list(tokens[0]))
+            np.asarray(logits)  # D2H sync (block_until_ready lies on axon)
+            dt = time.perf_counter() - t0
+            if rep:  # rep 0 compiles
+                best = min(best, dt)
+        print(f"chunk={chunk:5d}: {PROMPT_LEN / best:8.1f} tok/s "
+              f"({best * 1e3:7.1f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else "7b")
