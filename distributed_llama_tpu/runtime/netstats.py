@@ -117,9 +117,8 @@ def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
     all-reduce (amortized over iters; sync via device->host transfer, the
     only true sync on tunneled TPU platforms)."""
     import jax
-    import jax.numpy as jnp
     from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
     n = 1
@@ -137,9 +136,18 @@ def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
         return shard_map(body, mesh=mesh, in_specs=P(axes),
                          out_specs=P(axes), check_vma=False)(x)
 
-    x = jnp.ones((n, payload_elems), jnp.float32)
-    np.asarray(run(x))  # compile + warm
+    # explicit placement + local-shard fetch: on a multi-process mesh the
+    # sharded output spans non-addressable devices, so sync on a LOCAL shard
+    # (its completion implies the collective chain ran); np.asarray of the
+    # full array would raise, and block_until_ready lies on tunneled TPUs
+    x = jax.device_put(np.ones((n, payload_elems), np.float32),
+                       NamedSharding(mesh, P(axes)))
+
+    def sync(out):
+        np.asarray(out.addressable_shards[0].data)
+
+    sync(run(x))  # compile + warm
     t0 = time.perf_counter()
-    np.asarray(run(x))
+    sync(run(x))
     dt = time.perf_counter() - t0
     return dt / iters * 1e3

@@ -1,0 +1,45 @@
+#!/bin/sh
+# Local multi-process cluster demo — the direct analogue of the reference's
+# examples/n-workers.sh (which screen-launches N worker processes on ports
+# 9999-w for the root to dial). Here rank 0 is the root and ranks 1..N-1 run
+# `dllama worker`, all joined through a jax.distributed coordinator into ONE
+# global mesh (1 virtual CPU device per process). On real hosts, run the
+# same commands on each machine with a reachable --coordinator address.
+#
+# Usage: N=2 ./examples/cluster.sh
+set -e
+cd "$(dirname "$0")/.."
+N="${N:-2}"
+PORT="${PORT:-12765}"
+
+# tiny fixture model + tokenizer (the test suite's shared fixture writer);
+# on exit, kill any still-running workers before removing their model file
+TMP="$(mktemp -d)"
+trap 'for p in $(jobs -p); do kill "$p" 2>/dev/null || true; done; rm -rf "$TMP"' EXIT
+python - "$TMP" <<'EOF'
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # don't touch a TPU for file IO
+
+from distributed_llama_tpu.testing import write_fixture
+
+write_fixture(sys.argv[1], seed=7)
+EOF
+
+RUN="import jax; jax.config.update('jax_platforms','cpu'); \
+import sys; from distributed_llama_tpu.apps.dllama import main; \
+main(sys.argv[1:])"
+COMMON="--model $TMP/model.m --tokenizer $TMP/tok.t \
+  --nnodes $N --coordinator 127.0.0.1:$PORT --temperature 0 --seed 7"
+export XLA_FLAGS=--xla_force_host_platform_device_count=1
+
+r=1
+while [ "$r" -lt "$N" ]; do
+  python -c "$RUN" worker $COMMON --node-rank "$r" &
+  r=$((r + 1))
+done
+python -c "$RUN" inference $COMMON --node-rank 0 --prompt "Hello" --steps 8
+wait
+echo "✅ $N-process cluster: root + $((N - 1)) worker(s) generated in lock-step"

@@ -23,27 +23,10 @@ from distributed_llama_tpu.quants import FloatType
 
 
 def _fixture(tmp_path, rng, wt=FloatType.Q40):
-    # vocab 288 = 3 specials + 256 byte-fallback tokens + fillers (llama2.c
-    # convention: byte b maps to token b+3)
-    spec = ModelSpec(
-        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
-        n_kv_heads=2, vocab_size=288, seq_len=192, hidden_act=HiddenAct.SILU,
-        weights_float_type=wt)
-    tensors = {
-        name: rng.standard_normal(shape).astype(np.float32) * 0.05
-        for name, shape, _ in model_tensor_plan(spec)
-    }
-    mpath = str(tmp_path / "model.m")
-    write_model(mpath, spec, tensors)
+    from distributed_llama_tpu.testing import write_fixture
 
-    vocab = [b"<unk>", b"<s>", b"</s>"]
-    vocab += [f"<0x{b:02X}>".encode() for b in range(256)]  # byte-fallback pieces
-    while len(vocab) < spec.vocab_size:
-        vocab.append(f"<fill{len(vocab)}>".encode())
-    scores = [0.0] * len(vocab)
-    tpath = str(tmp_path / "tok.t")
-    write_tokenizer_file(tpath, TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
-    return mpath, tpath
+    return write_fixture(tmp_path, rng=rng, weights_float_type=wt,
+                         seq_len=192)
 
 
 def test_cli_mesh_flags_end_to_end(tmp_path, rng, capsys):

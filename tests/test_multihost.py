@@ -13,14 +13,9 @@ import socket
 import subprocess
 import sys
 
-import numpy as np
 import pytest
 
-from distributed_llama_tpu.io import (
-    TokenizerData, model_tensor_plan, write_model, write_tokenizer_file,
-)
-from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
-from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.testing import write_fixture
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -32,23 +27,7 @@ WRAPPER = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
 
 
 def _fixture(tmp_path):
-    spec = ModelSpec(
-        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
-        n_kv_heads=2, vocab_size=288, seq_len=160, hidden_act=HiddenAct.SILU,
-        weights_float_type=FloatType.Q40)
-    rng = np.random.default_rng(77)
-    tensors = {name: rng.standard_normal(shape).astype(np.float32) * 0.05
-               for name, shape, _ in model_tensor_plan(spec)}
-    mpath = str(tmp_path / "model.m")
-    write_model(mpath, spec, tensors)
-    vocab = [b"<unk>", b"<s>", b"</s>"]
-    vocab += [f"<0x{b:02X}>".encode() for b in range(256)]
-    while len(vocab) < spec.vocab_size:
-        vocab.append(f"<fill{len(vocab)}>".encode())
-    tpath = str(tmp_path / "tok.t")
-    write_tokenizer_file(tpath, TokenizerData(
-        vocab=vocab, scores=[0.0] * len(vocab), bos_id=1, eos_id=2))
-    return mpath, tpath
+    return write_fixture(tmp_path, seed=77)
 
 
 def _run(cli_args, n_local_devices=1, timeout=600):

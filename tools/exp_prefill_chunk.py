@@ -7,6 +7,12 @@ amortizes over more tokens. This measures tokens/sec for a 2048-token prompt
 at several chunk sizes to find the crossover (if any).
 
 Usage: python tools/exp_prefill_chunk.py [7b|tiny]
+
+Measured (v5e, 7B Q40, 2048-token prompt): 256-token fused chunks win by
+>2x — 128: 2196 tok/s, 256: 5771, 512: 2600, 1024: 1762, 2048: 2461 —
+the XLA dequant path never catches up even with the whole prompt in one
+segment, and 256 is also the kernel's VMEM ceiling for its (t, m) f32
+activation blocks. The engine default stands confirmed.
 """
 
 from __future__ import annotations
