@@ -65,6 +65,24 @@ def _to_q40_host(x: np.ndarray) -> HostTensor:
     return HostTensor("", FloatType.Q40, x.shape, scales=scales, packed=packed)
 
 
+def _replicate_kv_host(t: HostTensor, kvh: int, r: int) -> HostTensor:
+    """Repeat a kv projection's per-head row blocks r times (axis 0, row
+    order: virtual head j = real head j//r) — the host-side half of
+    models/params.replicate_kv_heads, done before placement so each device
+    receives only its virtual head's shard."""
+
+    def rep(a):
+        if a is None:
+            return None
+        per = a.shape[0] // kvh
+        return np.repeat(a.reshape(kvh, per, *a.shape[1:]), r,
+                         axis=0).reshape(kvh * r * per, *a.shape[1:])
+
+    return HostTensor(t.name, t.ftype, (t.shape[0] * r, *t.shape[1:]),
+                      data=rep(t.data), scales=rep(t.scales),
+                      packed=rep(t.packed))
+
+
 def _q40_raw_stack(ts: list[HostTensor]) -> tuple[np.ndarray, np.ndarray]:
     """(packed, scales) in raw block layout for one tensor or an E-stacked
     expert list — the single host-side Q40 pipeline every load path uses."""
@@ -293,6 +311,14 @@ def load_params_streamed(
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
     ep = mesh.shape.get(EP_AXIS, 1) if mesh is not None else 1
     pp = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
+    kv_rep = 1
+    if tp > spec.n_kv_heads:
+        # tp beyond the kv-head count: wk/wv rows replicate host-side into
+        # tp virtual heads BEFORE placement, so each device still receives
+        # exactly its shard (models/params.kv_replication)
+        from .params import kv_replication
+
+        kv_rep = kv_replication(spec, tp)
     if fuse is None:
         fuse = tp == 1
     if pp > 1:
@@ -322,11 +348,15 @@ def load_params_streamed(
         return p["layers"][l], None
 
     for t in iter_model_tensors(path, spec):
+        key = _leaf_key(t.name)
+        if kv_rep > 1 and key in ("wk", "wv"):
+            # replicate BEFORE accounting so live/peak measure the r-fold
+            # bytes actually resident during placement
+            t = _replicate_kv_host(t, spec.n_kv_heads, kv_rep)
         b = _host_bytes(t)
         total += b
         live += b
         peak = max(peak, live)
-        key = _leaf_key(t.name)
         dest, stage = target(t.name)
         group = _fuse_group(key) if fuse else None
 

@@ -133,6 +133,69 @@ def fuse_layer_weights(params: dict) -> dict:
     return params
 
 
+def kv_replication(spec: ModelSpec, tp: int) -> int:
+    """Replication factor r for tp > n_kv_heads, validating the config.
+
+    Relaxes the reference's hard `nSlices <= nKvHeads` constraint
+    (ref: src/transformer.cpp:254-257) — the planned extension the reference
+    could not do (SURVEY.md §7 step 4): GQA models with few kv heads (e.g.
+    70B's 8) can now shard over more chips (tp=16) by replicating each kv
+    head's projections and cache r = tp/n_kv_heads times as tp "virtual"
+    heads (virtual head j holds real head j//r). Query heads stay
+    contiguously sharded — shard s's H/tp query heads all belong to virtual
+    head s, so attention remains head-local like the reference's
+    MultiHeadAttSlice. Aggregate kv projection + cache memory grows r-fold,
+    but PER-DEVICE cache stays one head's worth — the same as at
+    tp = n_kv_heads — while per-device weights and FLOPs keep shrinking.
+    """
+    kvh = spec.n_kv_heads
+    assert tp % kvh == 0, (
+        f"tp={tp} must be a multiple of n_kv_heads={kvh} to replicate")
+    assert spec.n_heads % tp == 0, (
+        f"tp={tp} must divide n_heads={spec.n_heads}")
+    return tp // kvh
+
+
+def _repeat_head_rows(a, kvh: int, r: int):
+    """Repeat row-blocks of axis 0 (grouped per kv head) r times, so virtual
+    head j = real head j // r. Works for dense (kv_dim, n), Q40 packed
+    (kv_dim, m) and scales (kv_dim, nb)."""
+    per = a.shape[0] // kvh
+    rep = jnp.repeat(jnp.asarray(a).reshape(kvh, per, *a.shape[1:]), r, axis=0)
+    return rep.reshape(kvh * r * per, *a.shape[1:])
+
+
+def replicate_kv_heads(params: dict, spec: ModelSpec, tp: int) -> dict:
+    """Expand wk/wv to tp virtual heads (see kv_replication). Non-mutating
+    (fresh layer dicts, like repack_col_weights — callers may keep using
+    the original pytree); idempotent (already-expanded leaves are detected
+    by their row count, so loader-expanded params pass through)."""
+    r = kv_replication(spec, tp)
+    if r == 1:
+        return params
+    kvh = spec.n_kv_heads
+    params = dict(params)
+    params["layers"] = [dict(lw) for lw in params["layers"]]
+    for lw in params["layers"]:
+        for key in ("wk", "wv"):
+            w = lw.get(key)
+            if w is None:
+                continue  # fused wqkv exists only on the tp==1 path
+            if isinstance(w, QuantizedTensor):
+                if w.packed.shape[0] == spec.kv_dim * r:
+                    continue
+                assert w.packed.shape[0] == spec.kv_dim, w.packed.shape
+                lw[key] = QuantizedTensor(
+                    _repeat_head_rows(w.packed, kvh, r),
+                    _repeat_head_rows(w.scales, kvh, r))
+            else:
+                if w.shape[0] == spec.kv_dim * r:
+                    continue
+                assert w.shape[0] == spec.kv_dim, w.shape
+                lw[key] = _repeat_head_rows(w, kvh, r)
+    return params
+
+
 def random_tensors(spec: ModelSpec, seed: int = 0, scale: float = 0.02) -> dict[str, HostTensor]:
     """Synthetic host tensors for tests/benchmarks (numpy RNG, not xorshift —
     speed matters at 8B scale)."""

@@ -5,7 +5,9 @@ Net-new vs the reference, where every node runs every layer in lock-step
 mesh's `pp` axis shards the LAYER axis: device p stores only layers
 [p*L/pp, (p+1)*L/pp) — weights AND their KV cache — which is the
 model-size axis orthogonal to tp (pp*tp devices fit a model pp*tp times
-larger than one device, with tp bounded by n_kv_heads).
+larger than one device; tp itself can also exceed n_kv_heads via kv-head
+replication — models/params.kv_replication — which the engine applies
+before stage stacking).
 
 Execution model (single in-flight segment — decode and chunked prefill):
 the layer pytree is restacked so slot j's leaves carry a leading (pp,)

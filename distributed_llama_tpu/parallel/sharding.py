@@ -17,6 +17,10 @@ improvement: the reference computes all logits on root).
 The reference's `nSlices <= nKvHeads` constraint (ref:
 src/transformer.cpp:254-257) becomes `n_kv_heads % tp == 0` here; KV-cache
 heads shard on tp exactly like KvCacheSlice (ref: src/transformer.cpp:161-171).
+Unlike the reference, tp may also EXCEED the kv-head count: the engine then
+replicates wk/wv (and the cache) into tp virtual heads
+(models/params.kv_replication) and these specs apply unchanged — the relaxed
+form of the rule the reference could not support (SURVEY.md §7 step 4).
 """
 
 from __future__ import annotations
@@ -138,12 +142,16 @@ def cache_pspec(sp: bool = False, pp: bool = False) -> P:
 
 def check_tp_constraints(spec: ModelSpec, tp: int, q40: bool = False) -> None:
     """Divisibility rules; the reference asserts the same invariants
-    (ref: src/transformer.cpp:15,49,254-257,78-96)."""
+    (ref: src/transformer.cpp:15,49,254-257,78-96). The engine calls this
+    with its COMPUTE spec: when tp > the file's n_kv_heads it has already
+    replicated kv heads to tp virtual heads (models/params.kv_replication),
+    so the reference's nSlices <= nKvHeads bound is relaxed upstream."""
     if tp == 1:
         return
     assert spec.n_kv_heads % tp == 0, (
         f"tp={tp} must divide n_kv_heads={spec.n_kv_heads} "
-        "(reference constraint nSlices <= nKvHeads, transformer.cpp:254-257)")
+        "(reference constraint nSlices <= nKvHeads, transformer.cpp:254-257; "
+        "for tp > n_kv_heads the engine replicates kv heads first)")
     assert spec.n_heads % tp == 0
     assert spec.hidden_dim % tp == 0 and spec.dim % tp == 0
     if q40:

@@ -58,7 +58,6 @@ class Engine:
         use_pallas: bool | None = None,
         pallas_interpret: bool = False,
     ):
-        self.spec = spec
         self.mesh = mesh
         self.batch = batch
         self.seq_len = min(max_seq_len or spec.seq_len, spec.seq_len)
@@ -67,6 +66,18 @@ class Engine:
         self.activation_q80 = activation_q80
         self.prefill_chunk = prefill_chunk
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp > spec.n_kv_heads:
+            # kv-head replication: tp exceeds the kv-head count, so wk/wv
+            # expand to tp virtual heads and the spec the engine computes
+            # with reflects that (models/params.kv_replication — the relaxed
+            # form of the reference's nSlices <= nKvHeads rule)
+            import dataclasses
+
+            from ..models.params import replicate_kv_heads
+
+            params = replicate_kv_heads(params, spec, tp)
+            spec = dataclasses.replace(spec, n_kv_heads=tp)
+        self.spec = spec
         # --buffer-float-type q80 with tp>1 => wo/w2 partial sums exchange
         # int8 blocks over ICI instead of the GSPMD-exact f32 all-reduce
         # (the reference's wire compression, ref: src/tasks.cpp:124-163)
