@@ -108,6 +108,39 @@ def test_streamed_loader_replicates_host_side(tmp_path):
     assert _greedy(eng_s) == _greedy(eng_b)
 
 
+def test_kv_replication_composes_with_sp():
+    """tp=4 (over 2 kv heads) x sp=2: ring prefill + sp-sharded-cache decode
+    with virtual kv heads must match the single-device tokens."""
+    spec = _gqa_spec()
+    host, _ = dense_weights(spec, seed=15)
+    want = _greedy(Engine(spec, load_params(spec, host, mode="dense",
+                                            dtype=jnp.float32),
+                          compute_dtype=jnp.float32, cache_dtype=jnp.float32))
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    eng = Engine(spec, params, make_mesh(tp=4, sp=2),
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32)
+    assert eng.cache.k[0].shape[1] == 4  # virtual heads, sp-sharded seq dim
+    assert _greedy(eng) == want
+
+
+def test_kv_replication_composes_with_dp():
+    """tp=4 x dp=2 batched generation under kv replication: each row matches
+    the single-device greedy run."""
+    spec = _gqa_spec()
+    host, _ = dense_weights(spec, seed=16)
+    want = _greedy(Engine(spec, load_params(spec, host, mode="q40",
+                                            dtype=jnp.float32),
+                          compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                          use_pallas=False), n=4)
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    eng = Engine(spec, params, make_mesh(tp=4, dp=2), batch=2,
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                 use_pallas=False)
+    s = Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=3)
+    outs = eng.generate_batch([PROMPT, PROMPT], 4, s)
+    assert outs[0] == want and outs[1] == want, (outs, want)
+
+
 def test_kv_replication_validation():
     spec = _gqa_spec()
     assert kv_replication(spec, 4) == 2
