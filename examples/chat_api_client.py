@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Minimal client for the dllama api server — the counterpart of the
+reference's examples/chat-api-client.js (same two-question demo against
+/v1/chat/completions), stdlib-only, plus an SSE streaming variant.
+
+Usage:
+  1. Start the server:  python -m distributed_llama_tpu.apps.dllama api \
+         --model model.m --tokenizer tok.t --port 9990
+  2. Run this script:   python examples/chat_api_client.py
+     (HOST/PORT env vars override the default 127.0.0.1:9990)
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+
+HOST = os.environ.get("HOST", "127.0.0.1")
+PORT = int(os.environ.get("PORT", "9990"))
+
+
+def chat(messages, max_tokens: int, stream: bool = False):
+    conn = http.client.HTTPConnection(HOST, PORT, timeout=600)
+    conn.request("POST", "/v1/chat/completions", json.dumps({
+        "messages": messages,
+        "temperature": 0.7,
+        "stop": ["<|eot_id|>"],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if not stream:
+        out = json.loads(resp.read())
+        conn.close()
+        return out
+    # SSE: one "data: {...}" chunk per piece, terminated by "data: [DONE]"
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        delta = json.loads(payload)["choices"][0]["delta"]
+        if "content" in delta:
+            print(delta["content"], end="", flush=True)
+    print()
+    conn.close()
+
+
+def ask(system: str, user: str, max_tokens: int) -> None:
+    print(f"> system: {system}")
+    print(f"> user: {user}")
+    resp = chat([
+        {"role": "system", "content": system},
+        {"role": "user", "content": user},
+    ], max_tokens)
+    print(resp["choices"][0]["message"]["content"])
+    usage = resp["usage"]
+    print(f"({usage['prompt_tokens']} prompt + "
+          f"{usage['completion_tokens']} completion tokens)\n")
+
+
+if __name__ == "__main__":
+    ask("You are an excellent math teacher.", "What is 1 + 2?", 128)
+    ask("You are a weather forecaster.",
+        "What is the weather like in Tokyo?", 128)
+    print("> streaming:")
+    chat([{"role": "user", "content": "Count to five."}], 64, stream=True)
