@@ -94,6 +94,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of the generation to DIR "
                         "(view with tensorboard/xprof; net-new — the "
                         "reference has no profiler hooks, SURVEY.md §5.1)")
+    p.add_argument("--device-sampling", action="store_true",
+                   help="run the whole sampled decode loop on device (one "
+                        "lax.scan; temperature/top-p + reference-parity "
+                        "xorshift on the TPU — no host round-trip per "
+                        "token). Output streams after the loop. Net-new: "
+                        "the reference samples on CPU every token")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -240,7 +246,23 @@ def _maybe_profile(args):
     print(f"📈 profiler trace written to {args.profile}")
 
 
+def _stream_pieces(tokenizer, prev_token: int, toks: list[int]) -> None:
+    """Print a token list as decoded text (single place for the piece loop)."""
+    for tok in toks:
+        _safe_print(tokenizer.decode_piece(prev_token, tok).decode(
+            "utf-8", errors="replace"))
+        prev_token = tok
+    print()
+
+
 def cmd_generate(args, benchmark: bool) -> None:
+    if args.device_sampling:
+        if args.dp > 1:
+            sys.exit("error: --device-sampling is single-sequence; it does "
+                     "not compose with --dp")
+        if args.nnodes > 1:
+            sys.exit("error: --device-sampling does not compose with "
+                     "--nnodes (the worker protocol drives generate())")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
     tokens = tokenizer.encode(prompt)
@@ -255,16 +277,33 @@ def cmd_generate(args, benchmark: bool) -> None:
                                      _steps(args, engine), sampler,
                                      eos_id=tokenizer.stop_token_ids())
         dt = time.time() - t0
-        prev_t = tokens[-1]
-        for tok in outs[0]:
-            _safe_print(tokenizer.decode_piece(prev_t, tok).decode(
-                "utf-8", errors="replace"))
-            prev_t = tok
-        print()
+        _stream_pieces(tokenizer, tokens[-1], outs[0])
         if benchmark:
             n = sum(len(o) for o in outs)
             print(f"Generated tokens:    {n} ({engine.batch} sequences)")
             print(f"Avg tokens / second: {n / max(dt, 1e-9):.2f}")
+        return
+
+    if args.device_sampling:
+        t0 = time.time()
+        with _maybe_profile(args):
+            out = engine.generate_device(
+                tokens, _steps(args, engine),
+                temperature=args.temperature, topp=args.topp,
+                seed=sampler.rng_state,
+                eos_id=tokenizer.stop_token_ids(),
+                vocab_size=tokenizer.vocab_size)
+        dt = time.time() - t0
+        _stream_pieces(tokenizer, tokens[-1], out)
+        if benchmark:
+            # honest accounting: the one lax.scan runs its full budget (eos
+            # only truncates the OUTPUT) and this first call's wall time
+            # includes the scan's jit compile — don't fake a per-token rate
+            budget = min(_steps(args, engine), engine.seq_len - len(tokens))
+            print(f"Generated tokens:    {len(out)} (on-device loop, "
+                  f"{budget}-token scan)")
+            print(f"Wall time:           {dt:.2f} s "
+                  "(includes one-time scan compile)")
         return
 
     prev = [tokens[-1]]

@@ -1,0 +1,102 @@
+"""On-device token sampling — temperature / multinomial / top-p inside jit.
+
+Net-new vs the reference, whose sampler is inherently CPU-side per token
+(ref: src/tokenizer.cpp:231-364): here the whole sampling step (softmax,
+CDF draw, nucleus truncation) runs on the TPU inside the decode program, so
+sampled generation can use the same fully-on-device lax.scan as greedy
+decode (Engine.generate_device) — no host round-trip per token.
+
+The RNG is the reference's 64-bit xorshift* (ref: src/utils.cpp:53-64)
+implemented bit-exactly on two uint32 limbs (JAX x64 is off), so the coin
+stream matches utils/rng.py for any seed. Sampling semantics mirror
+sampler.Sampler step for step; the one deviation is CDF accumulation in
+f32 on device vs float64 on host, which can pick a neighboring token only
+when the coin lands within f32 epsilon of a CDF boundary (~1e-6/step odds).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_U32 = jnp.uint32
+
+
+def state_from_seed(seed: int) -> jnp.ndarray:
+    """(2,) uint32 [hi, lo] device RNG state from a 64-bit seed."""
+    seed &= (1 << 64) - 1
+    import numpy as np
+
+    return jnp.asarray(
+        np.array([seed >> 32, seed & 0xFFFFFFFF], np.uint32))
+
+
+def _mulhi_u32(a, b):
+    """High 32 bits of a 32x32 multiply, via 16-bit limbs (no u64)."""
+    a0, a1 = a & _U32(0xFFFF), a >> 16
+    b0, b1 = b & _U32(0xFFFF), b >> 16
+    p00, p01 = a0 * b0, a0 * b1
+    p10, p11 = a1 * b0, a1 * b1
+    mid = (p00 >> 16) + (p01 & _U32(0xFFFF)) + (p10 & _U32(0xFFFF))
+    return p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+
+
+def xorshift_step(state: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One xorshift* step on (2,) uint32 [hi, lo]; returns (state', u32
+    sample) — bit-identical to utils/rng.xorshift_u32."""
+    hi, lo = state[0], state[1]
+    hi, lo = hi ^ (hi >> 12), lo ^ ((lo >> 12) | (hi << 20))
+    hi, lo = hi ^ ((hi << 25) | (lo >> 7)), lo ^ (lo << 25)
+    hi, lo = hi ^ (hi >> 27), lo ^ ((lo >> 27) | (hi << 5))
+    # sample = bits 32..63 of state * 0x2545F4914F6CDD1D (mod 2^64)
+    mh, ml = _U32(0x2545F491), _U32(0x4F6CDD1D)
+    sample = _mulhi_u32(lo, ml) + lo * mh + hi * ml
+    return jnp.stack([hi, lo]), sample
+
+
+def coin_f32(state: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Random f32 in [0, 1) (ref: src/utils.cpp:61-64)."""
+    state, u = xorshift_step(state)
+    return state, (u >> 8).astype(jnp.float32) * jnp.float32(1.0 / 16777216.0)
+
+
+def sample_token(logits: jnp.ndarray, state: jnp.ndarray,
+                 temperature: float, topp: float
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample one token id from (vocab,) logits; returns (token i32, state').
+
+    temperature/topp are STATIC (the engine compiles per sampler config),
+    matching sampler.Sampler.sample's branch structure: temperature 0 ->
+    argmax (no coin drawn); topp outside (0, 1) -> plain multinomial; else
+    the reference's cutoff-prefilter + sort + truncate nucleus sampling
+    (ref: src/tokenizer.cpp:231-306).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits).astype(jnp.int32), state
+
+    x = logits.astype(jnp.float32) / jnp.float32(temperature)
+    x = jnp.exp(x - x.max())
+    probs = x / x.sum()
+    state, coin = coin_f32(state)
+    n = probs.shape[0]
+
+    if topp <= 0 or topp >= 1:
+        cdf = jnp.cumsum(probs)
+        idx = jnp.searchsorted(cdf, coin, side="right")
+        return jnp.minimum(idx, n - 1).astype(jnp.int32), state
+
+    cutoff = jnp.float32((1.0 - topp) / (n - 1))
+    keep = probs >= cutoff
+    # descending stable sort of candidates; non-candidates sink to the tail
+    # (key -1 < 0 <= any candidate prob) and contribute 0 to the cdf
+    key = jnp.where(keep, probs, -1.0)
+    order = jnp.argsort(-key, stable=True)
+    p_sorted = jnp.where(key[order] >= 0, probs[order], 0.0)
+    cum = jnp.cumsum(p_sorted)
+    over = cum > jnp.float32(topp)
+    n_cand = jnp.sum(keep) - 1  # last candidate position, if none exceed topp
+    last = jnp.where(over.any(), jnp.argmax(over), n_cand)
+    total = cum[last]
+    r = coin * total
+    idx = jnp.minimum(jnp.searchsorted(cum, r, side="right"), last)
+    return order[idx].astype(jnp.int32), state

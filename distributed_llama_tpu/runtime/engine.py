@@ -519,6 +519,86 @@ class Engine:
             self.pos = int(np.minimum(pos, self.seq_len).max())
         return out
 
+    # -- on-device SAMPLED decode loop ------------------------------------
+
+    def generate_device(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        *,
+        temperature: float,
+        topp: float,
+        seed: int,
+        eos_id: int | set[int] | None = None,
+        vocab_size: int | None = None,
+    ) -> list[int]:
+        """Sampled generation with the whole decode loop on device: one
+        lax.scan whose body samples (temperature/top-p, reference xorshift*
+        stream — ops/device_sampler.py) and steps the model, with no host
+        round-trip per token. Net-new vs the reference, whose sampler is
+        CPU-bound per token (ref: src/tokenizer.cpp:231-364).
+
+        Matches generate()+Sampler semantics step for step (device CDFs
+        accumulate in f32 vs the host's float64 — a neighboring-token pick
+        is possible only within f32 epsilon of a CDF boundary). The scan
+        always runs its full budget; output is truncated at the first stop
+        token and self.pos rewound there — overrun cache slots are
+        overwritten position-by-position before any later query can attend
+        them, so continuations stay correct. batch == 1.
+
+        vocab_size: sample only over the first vocab_size logits (the host
+        Sampler likewise truncates to the TOKENIZER's vocab, which can be
+        smaller than the model head — sampler.py:69)."""
+        assert self.batch == 1, "generate_device is single-sequence"
+        from ..ops.device_sampler import sample_token, state_from_seed
+
+        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+        n_vocab = min(vocab_size or self.spec.vocab_size,
+                      self.spec.vocab_size)
+        logits = self.prefill(prompt)
+        # every scanned token is followed by its forward's cache write at
+        # pos, so writes stay < seq_len (the host loop can emit one final
+        # token at the exact context edge without a step; the scan cannot)
+        max_tokens = min(max_tokens, self.seq_len - self.pos)
+
+        spec = self.spec
+        key = ("dsample", max_tokens, float(temperature), float(topp),
+               n_vocab)
+        if key not in self._steps:
+            common = self._forward_kwargs()
+
+            @partial(jax.jit, donate_argnums=(3,))
+            def run(params, logits0, pos0, cache, rng):
+                def body(carry, _):
+                    lgt, pos, cache, rng = carry
+                    tok, rng = sample_token(lgt[0, :n_vocab], rng,
+                                            temperature, topp)
+                    nxt, cache = forward(params, spec, tok[None, None], pos,
+                                         cache, **common)
+                    return (nxt, pos + 1, cache, rng), tok
+
+                (_, _, cache, _), toks = jax.lax.scan(
+                    body, (logits0, pos0, cache, rng), None,
+                    length=max_tokens)
+                return toks, cache
+
+            self._steps[key] = run
+
+        toks, self.cache = self._steps[key](
+            self.params, logits, jnp.int32(self.pos), self.cache,
+            state_from_seed(seed))
+        out: list[int] = []
+        for t in np.asarray(toks).tolist():  # D2H is also the sync point
+            out.append(int(t))
+            if int(t) in stop_ids:
+                break
+        # host-parity position: generate() never steps (so never writes) the
+        # last emitted token — rewind to pos0 + len(out) - 1; the scan's
+        # overrun writes get overwritten position-by-position by later
+        # prefill/decode before any query can attend them
+        self.pos += max(len(out) - 1, 0)
+        return out
+
     # -- on-device greedy decode loop (benchmark path) --------------------
 
     def decode_greedy_device(self, first_token: int, n_tokens: int) -> tuple[np.ndarray, float]:
