@@ -96,10 +96,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "reference has no profiler hooks, SURVEY.md §5.1)")
     p.add_argument("--device-sampling", action="store_true",
                    help="run the whole sampled decode loop on device (one "
-                        "lax.scan; temperature/top-p + reference-parity "
-                        "xorshift on the TPU — no host round-trip per "
-                        "token). Output streams after the loop. Net-new: "
-                        "the reference samples on CPU every token")
+                        "lax.while_loop that exits at eos; temperature/"
+                        "top-p + reference-parity xorshift on the TPU — no "
+                        "host round-trip per token). Composes with --dp: "
+                        "each batch row gets its own device RNG stream. "
+                        "Output streams after the loop. Net-new: the "
+                        "reference samples on CPU every token")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -145,9 +147,19 @@ def build_engine(args):
     multihost = jax.process_count() > 1
     if multihost:
         # every process must agree on the mesh/dtype flags (the reference
-        # memcpys its spec struct over the socket and hopes — we verify)
+        # memcpys its spec struct over the socket and hopes — we verify).
+        # The MODEL SPEC and TOKENIZER are fingerprinted too: hosts loading
+        # different .m/.t files would desync eos step counts and hang the
+        # cluster in a mismatched collective instead of erroring (ADVICE r2)
+        import dataclasses
+        import zlib
+
         from ..parallel.multihost import check_config
-        check_config([args.tp, args.dp, args.sp, args.ep, args.pp,
+        spec_fp = zlib.crc32(repr(dataclasses.astuple(spec)).encode())
+        with open(args.tokenizer, "rb") as f:
+            tok_fp = zlib.crc32(f.read())
+        check_config([spec_fp, tok_fp,
+                      args.tp, args.dp, args.sp, args.ep, args.pp,
                       int(args.buffer_float_type == "q80"),
                       int(args.compute_dtype == "bf16"),
                       ["bf16", "f32", "f8"].index(args.cache_dtype),
@@ -235,15 +247,18 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _maybe_profile(args):
-    """jax.profiler trace of the generation when --profile DIR is given."""
-    if not args.profile:
+def _maybe_profile(args, trace_dir=None):
+    """jax.profiler trace of the generation when --profile DIR is given (or
+    an explicit dir — the benchmark mode's per-step T capture)."""
+    target = trace_dir or args.profile
+    if not target:
         yield
         return
     import jax.profiler
-    with jax.profiler.trace(args.profile):
+    with jax.profiler.trace(target):
         yield
-    print(f"📈 profiler trace written to {args.profile}")
+    if args.profile:
+        print(f"📈 profiler trace written to {args.profile}")
 
 
 def _stream_pieces(tokenizer, prev_token: int, toks: list[int]) -> None:
@@ -256,13 +271,9 @@ def _stream_pieces(tokenizer, prev_token: int, toks: list[int]) -> None:
 
 
 def cmd_generate(args, benchmark: bool) -> None:
-    if args.device_sampling:
-        if args.dp > 1:
-            sys.exit("error: --device-sampling is single-sequence; it does "
-                     "not compose with --dp")
-        if args.nnodes > 1:
-            sys.exit("error: --device-sampling does not compose with "
-                     "--nnodes (the worker protocol drives generate())")
+    if args.device_sampling and args.nnodes > 1:
+        sys.exit("error: --device-sampling does not compose with "
+                 "--nnodes (the worker protocol drives generate())")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
     tokens = tokenizer.encode(prompt)
@@ -272,10 +283,19 @@ def cmd_generate(args, benchmark: bool) -> None:
         # dp throughput mode: the batch rows generate independently (here the
         # same prompt replicated); row 0 streams to stdout
         t0 = time.time()
-        _announce_run(tokens, _steps(args, engine), sampler=sampler)
-        outs = engine.generate_batch([tokens] * engine.batch,
-                                     _steps(args, engine), sampler,
-                                     eos_id=tokenizer.stop_token_ids())
+        if args.device_sampling:
+            with _maybe_profile(args):
+                outs = engine.generate_batch_device(
+                    [tokens] * engine.batch, _steps(args, engine),
+                    temperature=args.temperature, topp=args.topp,
+                    seed=sampler.rng_state,
+                    eos_id=tokenizer.stop_token_ids(),
+                    vocab_size=tokenizer.vocab_size)
+        else:
+            _announce_run(tokens, _steps(args, engine), sampler=sampler)
+            outs = engine.generate_batch([tokens] * engine.batch,
+                                         _steps(args, engine), sampler,
+                                         eos_id=tokenizer.stop_token_ids())
         dt = time.time() - t0
         _stream_pieces(tokenizer, tokens[-1], outs[0])
         if benchmark:
@@ -296,14 +316,12 @@ def cmd_generate(args, benchmark: bool) -> None:
         dt = time.time() - t0
         _stream_pieces(tokenizer, tokens[-1], out)
         if benchmark:
-            # honest accounting: the one lax.scan runs its full budget (eos
-            # only truncates the OUTPUT) and this first call's wall time
-            # includes the scan's jit compile — don't fake a per-token rate
-            budget = min(_steps(args, engine), engine.seq_len - len(tokens))
+            # honest accounting: this first call's wall time includes the
+            # loop's jit compile — don't fake a per-token rate
             print(f"Generated tokens:    {len(out)} (on-device loop, "
-                  f"{budget}-token scan)")
+                  f"{engine.last_device_steps} device steps)")
             print(f"Wall time:           {dt:.2f} s "
-                  "(includes one-time scan compile)")
+                  "(includes one-time loop compile)")
         return
 
     prev = [tokens[-1]]
@@ -313,35 +331,77 @@ def cmd_generate(args, benchmark: bool) -> None:
         prev[0] = tok
 
     _announce_run(tokens, _steps(args, engine), sampler=sampler)
-    with _maybe_profile(args):
-        res = engine.generate(tokens, _steps(args, engine), sampler,
-                              eos_id=tokenizer.stop_token_ids(),
-                              on_token=on_token)
-    print()
-    if benchmark:
-        # per-token G/I/T/S lines + averages (ref: dllama.cpp:47-48,74-91);
-        # S = modeled per-device collective kB, T = measured all-reduce
-        # microbench scaled to the per-layer reduce count (netstats.py)
-        wire = engine.wire_estimate()
-        if jax.process_count() > 1:
-            from ..parallel import multihost as mh
-            mh.send_xfer_bench()  # workers join the collective microbench
-        t_ms = engine.measure_transfer_ms()
-        for i, s in enumerate(res.stats.steps):
-            print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
-                  f"T {t_ms:6.2f} ms H {s.host_ms:5.2f} ms "
-                  f"S {wire.sent_kb_per_token:7.1f} kB")
-        avg = res.stats.averages()
-        n = len(res.tokens)
-        print(f"Generated tokens:    {n}")
-        print(f"Avg tokens / second: {1000.0 / max(avg.generation_ms, 1e-9):.2f}")
-        print(f"Avg generation time: {avg.generation_ms:.2f} ms")
-        print(f"Avg inference time:  {avg.device_ms:.2f} ms")
+    # benchmark mode on a single-process multi-device mesh: capture a trace
+    # so T is the MEASURED per-step collective time from the device
+    # timeline (netstats.per_step_op_ms), not a repeated microbench
+    # constant — the reference's T column is genuinely per-token
+    # (ref: src/apps/dllama/dllama.cpp:74-79)
+    trace_dir = args.profile
+    auto_trace = (benchmark and trace_dir is None and engine.mesh is not None
+                  and engine.mesh.size > 1 and jax.process_count() == 1)
+    if auto_trace:
+        import tempfile
+        trace_dir = tempfile.mkdtemp(prefix="dllama-trace-")
+    try:
+        with _maybe_profile(args, trace_dir):
+            res = engine.generate(tokens, _steps(args, engine), sampler,
+                                  eos_id=tokenizer.stop_token_ids(),
+                                  on_token=on_token)
+        print()
+        if benchmark:
+            _print_benchmark(args, engine, res, trace_dir=trace_dir)
+    finally:
+        if auto_trace:  # parsed above; traces are tens of MB per run
+            import shutil
+            shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+def _print_benchmark(args, engine, res, trace_dir=None) -> None:
+    """Per-token G/I/T/S lines + averages (ref: dllama.cpp:47-48,74-91);
+    S = modeled per-device collective kB, T = measured per-step collective
+    time from the trace (falling back to the all-reduce microbench scaled
+    to the per-layer reduce count — netstats.py)."""
+    wire = engine.wire_estimate()
+    if jax.process_count() > 1:
+        from ..parallel import multihost as mh
+        mh.send_xfer_bench()  # workers join the collective microbench
+    t_ms = engine.measure_transfer_ms()
+    t_steps: list[float] = []
+    if trace_dir:
+        from ..runtime.netstats import per_step_op_ms
+
+        mod_t = per_step_op_ms(trace_dir, module_hint="run")
+        if mod_t and len(res.stats.steps) > 1:
+            # module executions = prefill chunks + decode steps; align
+            # decode steps from the tail, fold the prefill chunks into
+            # the first stats row
+            n_dec = min(len(res.stats.steps) - 1, len(mod_t))
+            tail = mod_t[len(mod_t) - n_dec:]
+            t_steps = [sum(mod_t[: len(mod_t) - n_dec])] + tail
+        elif mod_t:
+            t_steps = [sum(mod_t)]
+    for i, s in enumerate(res.stats.steps):
+        tv = t_steps[i] if i < len(t_steps) else t_ms
+        print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
+              f"T {tv:6.2f} ms H {s.host_ms:5.2f} ms "
+              f"S {wire.sent_kb_per_token:7.1f} kB")
+    avg = res.stats.averages()
+    n = len(res.tokens)
+    print(f"Generated tokens:    {n}")
+    print(f"Avg tokens / second: {1000.0 / max(avg.generation_ms, 1e-9):.2f}")
+    print(f"Avg generation time: {avg.generation_ms:.2f} ms")
+    print(f"Avg inference time:  {avg.device_ms:.2f} ms")
+    if len(t_steps) > 1:
+        t_avg = sum(t_steps[1:]) / len(t_steps[1:])
+        print(f"Avg transfer:        {t_avg:.2f} ms/token measured "
+              f"(trace; microbench estimate {t_ms:.2f} ms), "
+              f"{wire.sent_kb_per_token:.1f} kB/token/device")
+    else:
         print(f"Avg transfer (est):  {t_ms:.2f} ms, "
               f"{wire.sent_kb_per_token:.1f} kB/token/device")
-        for kname, kb in wire.breakdown.items():
-            print(f"  {kname}: {kb:.1f} kB")
-        print(f"Avg sampling time:   {avg.host_ms:.2f} ms")
+    for kname, kb in wire.breakdown.items():
+        print(f"  {kname}: {kb:.1f} kB")
+    print(f"Avg sampling time:   {avg.host_ms:.2f} ms")
 
 
 def cmd_chat(args) -> None:
@@ -426,16 +486,27 @@ def cmd_worker(args) -> None:
             # prompt build, sampling, stop scan are all deterministic
             import json
 
-            from .api_server import ApiState, _completion_chunks
+            from .api_server import ApiState, PromptTooLong, _completion_chunks
             if api_state is None:
                 api_state = ApiState(engine, tokenizer, sampler)
             try:
                 for _ in _completion_chunks(api_state, json.loads(msg.body)):
                     pass
-            except Exception as e:  # noqa: BLE001 — a bad request must not
-                # kill the worker while the root's HTTP server lives on; the
-                # root raised the same deterministic error at the same point
+            except (PromptTooLong, json.JSONDecodeError, KeyError,
+                    TypeError) as e:
+                # deterministic request errors: the root raised the SAME
+                # error at the same point, so state stays in lock-step
                 print(f"⚠️  request failed: {type(e).__name__}: {e}")
+            except Exception as e:  # noqa: BLE001 — worker-LOCAL failure
+                # (OOM, I/O) the root never hit: engine/session state has
+                # diverged from the root's. Resync to a known state — fresh
+                # cache, empty session — so subsequent requests line their
+                # collectives up again (the sampler state was restored by
+                # _completion_chunks' finally) (ADVICE r2)
+                print(f"⚠️  request failed locally ({type(e).__name__}: {e})"
+                      " — resyncing engine state")
+                api_state.cached_tokens = []
+                engine.reset()
         elif msg.kind == mh.MSG_XFER_BENCH:
             engine.measure_transfer_ms()
 
@@ -460,6 +531,7 @@ def main(argv: list[str] | None = None) -> None:
         sys.exit("error: worker mode needs a cluster — pass --nnodes N "
                  "--node-rank r --coordinator host:port (single-host "
                  "multi-device runs need no workers: use --tp N)")
+    clean = True
     try:
         if args.mode == "worker":
             cmd_worker(args)
@@ -472,10 +544,20 @@ def main(argv: list[str] | None = None) -> None:
         elif args.mode == "api":
             from .api_server import serve
             serve(args)
+    except BaseException:
+        clean = False
+        raise
     finally:
         if args.nnodes > 1 and args.mode != "worker":
-            from ..parallel import multihost as mh
-            mh.send_shutdown()
+            # clean exit: workers are blocked in a header read, where the
+            # SHUTDOWN broadcast pairs cleanly (multihost.py framing). After
+            # a mid-run crash they may instead sit in step collectives — a
+            # shutdown broadcast would hang THIS process too, so skip it
+            # and rely on jax.distributed coordinator teardown to tear the
+            # workers down when the root process exits (ADVICE r2)
+            if clean:
+                from ..parallel import multihost as mh
+                mh.send_shutdown()
 
 
 if __name__ == "__main__":

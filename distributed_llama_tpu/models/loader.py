@@ -187,20 +187,26 @@ class _Placer:
         from ..parallel.ep_moe import EpColWeight
         from ..parallel.tp_q80 import TpColWeight
 
-        tp = self.tp
-        nb = packed.shape[-2]
-        assert nb % tp == 0, (nb, tp)
-        lead = packed.shape[:-2]
-        pk = packed.reshape(*lead, tp, nb // tp, 16)
-        pk = np.moveaxis(pk, -3, 0)                      # (tp, ..., nb/tp, 16)
-        sc = np.moveaxis(scales.reshape(*lead, tp, nb // tp), -2, 0)
-        pk_dev, sc_dev = QuantizedTensor.host_layout(
-            np.ascontiguousarray(sc), np.ascontiguousarray(pk))
+        pk_dev, sc_dev = _col_q40_host(packed, scales, self.tp)
         wrap = EpColWeight if ep else TpColWeight
         return wrap(QuantizedTensor(
             self._put(pk_dev, _col_stack_pspec(pk_dev.ndim, ep=ep)),
             self._put(sc_dev, _col_stack_pspec(sc_dev.ndim, ep=ep)),
         ))
+
+
+def _col_q40_host(packed: np.ndarray, scales: np.ndarray, tp: int
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Raw-layout Q40 (…, nb, 16) -> block-aligned (tp, …, nb/tp…) col stack
+    in the flattened device layout (parallel/tp_q80.repack_col_tp semantics,
+    host-side)."""
+    nb = packed.shape[-2]
+    assert nb % tp == 0, (nb, tp)
+    lead = packed.shape[:-2]
+    pk = np.moveaxis(packed.reshape(*lead, tp, nb // tp, 16), -3, 0)
+    sc = np.moveaxis(scales.reshape(*lead, tp, nb // tp), -2, 0)
+    return QuantizedTensor.host_layout(
+        np.ascontiguousarray(sc), np.ascontiguousarray(pk))
 
 
 def _col_stack_pspec(ndim: int, ep: bool = False):
@@ -218,9 +224,10 @@ class _PpStacker:
     per-device footprint is the final L/pp share plus one transient host
     tensor — never the full-L restack the engine-side path pays."""
 
-    def __init__(self, mesh, pp: int):
+    def __init__(self, mesh, pp: int, tp: int = 1):
         self.mesh = mesh
         self.pp = pp
+        self.tp = tp
 
         @functools.partial(jax.jit, donate_argnums=0, static_argnums=3)
         def update(buf, row, stage, sharding):
@@ -248,6 +255,7 @@ class _PpStacker:
         """Fold one layer tensor (or fused/expert-stacked group) into the
         slot's stage-stacked leaf."""
         from ..parallel.pp import PpWeight
+        from ..parallel.tp_q80 import TpColWeight
 
         cur = slot.get(key)
         if mode != "q40" or keep_f32:
@@ -257,6 +265,22 @@ class _PpStacker:
             slot[key] = PpWeight(self._row(
                 cur.w if cur is not None else None, x, stage, spec,
                 leaf_dtype))
+            return
+        if key in COL_SPLIT_NAMES and self.tp > 1:
+            # pp's fully-manual region slices weights at placement: q40 col
+            # shards must be block-aligned TpColWeight stacks, stage-stacked
+            # to (pp, tp, ..., d, m/tp) — PpWeight(TpColWeight(...))
+            packed, scales = _q40_raw_stack(ts)
+            pk, sc = _col_q40_host(packed, scales, self.tp)
+            inner = P(TP_AXIS, *([None] * (pk.ndim - 1)))
+            old = cur.w.w if cur is not None else None
+            slot[key] = PpWeight(TpColWeight(QuantizedTensor(
+                self._row(old.packed if old is not None else None, pk,
+                          stage, inner, pk.dtype),
+                self._row(old.scales if old is not None else None, sc,
+                          stage, P(TP_AXIS, *([None] * (sc.ndim - 1))),
+                          sc.dtype),
+            )))
             return
         pk, sc = _q40_host_stack(ts)
         old = cur.w if cur is not None else None
@@ -327,7 +351,7 @@ def load_params_streamed(
             "pp loading composes with tp/dp only (matching Engine)")
     n_slot = spec.n_layers // pp
     placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep)
-    pp_stack = _PpStacker(mesh, pp) if pp > 1 else None
+    pp_stack = _PpStacker(mesh, pp, tp=tp) if pp > 1 else None
 
     p: dict = {"layers": [dict() for _ in range(n_slot if pp > 1
                                                 else spec.n_layers)]}

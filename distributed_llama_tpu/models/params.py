@@ -133,6 +133,35 @@ def fuse_layer_weights(params: dict) -> dict:
     return params
 
 
+def _split_rows(w, cuts: list[int]) -> list:
+    """Split a matmul weight back along the output dim at `cuts`."""
+    if isinstance(w, QuantizedTensor):
+        return [QuantizedTensor(w.packed[a:b], w.scales[a:b])
+                for a, b in zip([0] + cuts, cuts + [w.packed.shape[0]])]
+    return [w[a:b] for a, b in zip([0] + cuts, cuts + [w.shape[0]])]
+
+
+def unfuse_layer_weights(params: dict, spec: ModelSpec) -> dict:
+    """Inverse of fuse_layer_weights (exact row slices), for engines built
+    at tp > 1 from a params dict another (tp == 1) engine already fused —
+    fuse mutates in place, and a row split of the fused [q|k|v] output dim
+    does not align with the projection boundaries, which the fully-manual
+    pp region (unlike GSPMD, whose sharding never changes semantics) would
+    silently miscompute. No-op when nothing is fused."""
+    if not any("wqkv" in lw or "w13" in lw for lw in params["layers"]):
+        return params
+    d, kv, h = spec.dim, spec.kv_dim, spec.hidden_dim
+    params = dict(params)
+    params["layers"] = [dict(lw) for lw in params["layers"]]
+    for lw in params["layers"]:
+        if "wqkv" in lw:
+            lw["wq"], lw["wk"], lw["wv"] = _split_rows(
+                lw.pop("wqkv"), [d, d + kv])
+        if "w13" in lw:
+            lw["w1"], lw["w3"] = _split_rows(lw.pop("w13"), [h])
+    return params
+
+
 def kv_replication(spec: ModelSpec, tp: int) -> int:
     """Replication factor r for tp > n_kv_heads, validating the config.
 

@@ -45,6 +45,12 @@ GROK_INPUT_SCALE = 78.38367176906169      # ref: src/grok1-tasks.cpp:13
 GROK_LOGIT_SCALE = 0.5773502691896257     # ref: src/grok1-tasks.cpp:271
 
 
+def _flash_ok(t: int, h: int, kvh: int) -> bool:
+    from ..ops.pallas_attention import flash_supported
+
+    return flash_supported(t, h, kvh)
+
+
 class KVCache(NamedTuple):
     """Per-layer KV cache: tuples of L arrays, each (B, KVH, S, hs).
 
@@ -104,6 +110,13 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
     """
     b, t, d = x.shape
     h, kvh, hs = spec.n_heads, spec.n_kv_heads, spec.head_size
+    f = cfg.get("manual_tp") or 1
+    if f > 1:
+        # fully-manual pp region: this shard computes h/tp query heads and
+        # kvh/tp kv heads (row-split projections, head-sharded cache) — the
+        # same per-shard shapes tp_q80's shard_map bodies see. RoPE and
+        # attention are per-head, so only the reshape bookkeeping changes.
+        h, kvh = h // f, kvh // f
 
     xb = rmsnorm(x, lw["rms_att"])  # ref: llama2-tasks.cpp:10-21
     if "wqkv" in lw:
@@ -180,8 +193,19 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
         from ..parallel.ring_attention import sp_cache_attention
 
         att = sp_cache_attention(q, k_cache, v_cache, q_pos, sp_cache_mesh)
-    elif t == 1 and cfg.get("use_pallas"):
-        if cfg.get("tp_mesh") is not None:
+    elif cfg.get("use_pallas") and _flash_ok(t, h, kvh):
+        # decode (T=1) and chunked prefill (T>1) both take the flash kernel:
+        # online-softmax in VMEM instead of the dense path's (B,T,KVH,G,S)
+        # score materialization in HBM (ops/pallas_attention.py)
+        if cfg.get("manual_tp"):
+            # already inside the fully-manual pp region: heads are local,
+            # call the kernel directly (no shard_map entry)
+            from ..ops.pallas_attention import flash_attention
+
+            att = flash_attention(
+                q, k_cache, v_cache, q_pos,
+                interpret=cfg.get("pallas_interpret", False))
+        elif cfg.get("tp_mesh") is not None:
             # multi-device mesh: GSPMD can't partition a pallas_call, so the
             # kernel runs per-shard inside shard_map (dp on batch, tp on
             # kv-heads — head-local, no collective)
@@ -191,9 +215,9 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
                 q, k_cache, v_cache, q_pos, cfg["tp_mesh"],
                 interpret=cfg.get("pallas_interpret", False))
         else:
-            from ..ops.pallas_attention import flash_decode_attention
+            from ..ops.pallas_attention import flash_attention
 
-            att = flash_decode_attention(
+            att = flash_attention(
                 q, k_cache, v_cache, q_pos,
                 interpret=cfg.get("pallas_interpret", False))
     else:
@@ -264,16 +288,29 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
     if t == 1 and b == 1:
         # decode: gather only the K active experts' weights (the reference
         # likewise computes just the active experts — grok1-tasks.cpp:128-143)
+        from ..ops.matmul import fused_expert_matmul
+
         idx = top_idx.reshape(k_active)
         acc = jnp.zeros((b, t, d), xb.dtype)
         for ae in range(k_active):  # K is tiny and static — unrolled
             e = idx[ae]
-            out = expert_apply(
-                _take_expert(lw["moe_up"], e),
-                _take_expert(lw["moe_gate"], e),
-                _take_expert(lw["moe_down"], e),
-                xb,
-            )
+            # expert-indexed fused kernel when eligible: the kernel reads the
+            # active expert's packed bytes in place instead of paying a
+            # dynamic-slice HBM copy per expert per layer (pallas_q40.py)
+            out = None
+            gate = fused_expert_matmul(xb, lw["moe_gate"], e, **cfg)
+            up = (fused_expert_matmul(xb, lw["moe_up"], e, **cfg)
+                  if gate is not None else None)
+            if gate is not None and up is not None:
+                hb = apply_hidden_act(gate, spec.hidden_act) * up
+                out = fused_expert_matmul(hb, lw["moe_down"], e, **cfg)
+            if out is None:
+                out = expert_apply(
+                    _take_expert(lw["moe_up"], e),
+                    _take_expert(lw["moe_gate"], e),
+                    _take_expert(lw["moe_down"], e),
+                    xb,
+                )
             acc = acc + weights[..., ae, None].astype(out.dtype) * out
         return acc
 

@@ -87,6 +87,10 @@ def sample_token(logits: jnp.ndarray, state: jnp.ndarray,
 
     cutoff = jnp.float32((1.0 - topp) / (n - 1))
     keep = probs >= cutoff
+    # near-uniform probs with topp < 1/n can leave no candidate, which
+    # would wrap `last` negative below; keep the (first) argmax then —
+    # the same fallback as the host Sampler and the native twin
+    keep = jnp.where(keep.any(), keep, jnp.arange(n) == jnp.argmax(probs))
     # descending stable sort of candidates; non-candidates sink to the tail
     # (key -1 < 0 <= any candidate prob) and contribute 0 to the cdf
     key = jnp.where(keep, probs, -1.0)

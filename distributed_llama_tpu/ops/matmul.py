@@ -63,6 +63,7 @@ def matmul(
     tp_mesh=None,
     tp_reduce: str = "exact",
     pallas_interpret: bool = False,
+    manual_tp: int = 0,
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
@@ -77,6 +78,12 @@ def matmul(
     arrive as TpRowWeight (row-split, communication-free) or TpColWeight
     (col-split partial sums, reduced per tp_reduce: "exact" psum or the
     reference's "q80" compressed exchange) — parallel/tp_q80.py.
+
+    manual_tp: > 0 when the caller is ALREADY inside a fully-manual region
+    (the pipeline-parallel layer loop, parallel/pp.py) with tp manual and
+    this many shards: Tp-marked weights are shard-local there, so row splits
+    run the local kernel directly and col splits reduce with an explicit
+    psum — no shard_map entry (which cannot nest).
     """
     if activation_q80:
         q, scales = quantize_q80_jax(x)
@@ -85,7 +92,21 @@ def matmul(
         x = x.astype(compute_dtype)
 
     from ..parallel.tp_q80 import (
-        TpColWeight, TpRowWeight, tp_col_matmul, tp_row_matmul)
+        TpColWeight, TpRowWeight, manual_psum, tp_col_matmul, tp_row_matmul)
+
+    if manual_tp:
+        from ..parallel.mesh import TP_AXIS
+
+        if isinstance(w, TpColWeight):
+            partial = local_matmul(x, w.w, compute_dtype=compute_dtype,
+                                   use_pallas=use_pallas,
+                                   interpret=pallas_interpret)
+            return (manual_psum(partial, TP_AXIS) if manual_tp > 1
+                    else partial)
+        if isinstance(w, TpRowWeight):
+            w = w.w
+        return local_matmul(x, w, compute_dtype=compute_dtype,
+                            use_pallas=use_pallas, interpret=pallas_interpret)
 
     if isinstance(w, TpColWeight):
         assert tp_mesh is not None, "TpColWeight requires the mesh in cfg"
@@ -100,3 +121,43 @@ def matmul(
 
     return local_matmul(x, w, compute_dtype=compute_dtype,
                         use_pallas=use_pallas, interpret=pallas_interpret)
+
+
+def fused_expert_matmul(
+    x: jnp.ndarray,
+    w,                      # stacked (E, d, n) weight leaf
+    e: jnp.ndarray,         # traced i32 expert index
+    *,
+    activation_q80: bool = False,
+    compute_dtype=jnp.float32,
+    use_pallas: bool = False,
+    tp_mesh=None,
+    tp_reduce: str = "exact",
+    pallas_interpret: bool = False,
+    manual_tp: int = 0,
+):
+    """Expert-indexed matmul against a stacked (E, d, n) Q40 weight without
+    materializing the expert's slice (ops/pallas_q40.q40_expert_matmul).
+
+    Returns None when ineligible — plain-QuantizedTensor single-shard Q40
+    stacks only (which includes manual-region pp layers at tp == 1, where
+    the local stack is the whole weight); the caller falls back to
+    gather-then-matmul (which is also what the mesh paths' Tp/Ep wrappers
+    take)."""
+    del tp_reduce, manual_tp
+    if not (use_pallas and tp_mesh is None
+            and isinstance(w, QuantizedTensor) and w.packed.ndim == 3):
+        return None
+    from .pallas_q40 import MAX_T, q40_expert_matmul
+
+    t = 1
+    for s in x.shape[:-1]:
+        t *= s
+    if t > MAX_T:
+        return None
+    if activation_q80:  # same round-trip matmul() applies
+        q, scales = quantize_q80_jax(x)
+        x = dequantize_q80_jax(q, scales, dtype=compute_dtype)
+    return q40_expert_matmul(x.astype(compute_dtype), w, e,
+                             out_dtype=compute_dtype,
+                             interpret=pallas_interpret)

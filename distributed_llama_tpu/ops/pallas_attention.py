@@ -1,29 +1,36 @@
-"""Pallas TPU kernel: flash-decode attention over the KV cache.
+"""Pallas TPU kernel: flash attention over the KV cache (decode AND chunked
+prefill).
 
 TPU-native replacement for the reference's serial per-head attention loop
 (ref: src/llama2-tasks.cpp:54-94). XLA's fused decode attention kept
 assigning the KV cache a head-minor layout (32 kv heads in the 128-lane
-dim -> 4x lane waste, ~75 GB/s effective on v5e); this kernel fixes the
-read pattern by construction: each grid step streams one head's (SB, hs)
-key/value panel — hs=128 exactly fills the lanes — and keeps the running
-softmax state in VMEM scratch, so scores never touch HBM.
+dim -> 4x lane waste, ~75 GB/s effective on v5e); and for prefill chunks the
+dense path materializes the full (B, T, KVH, G, S) score tensor in HBM
+(ops/attention.py:56-63 — 67 MB per layer at T=256/S=2048). This kernel
+fixes both by construction: each grid step streams one head's (SB, hs)
+key/value panel — hs=128 exactly fills the lanes — against the head's
+(T*G, hs) query panel, and keeps the running softmax state in VMEM scratch,
+so scores never touch HBM.
 
-Shapes: q (B, KVH, G, hs) where G = n_heads/n_kv_heads (GQA group,
-ref kvMul: src/llama2-tasks.cpp:60); k/v cache (B, KVH, S, hs). Grid is
-(B*KVH, S/SB) with the sequence dimension innermost: scratch acc/m/l carry
-the online-softmax state across S blocks of the same head (flash
-decomposition), reset at block 0 and finalized at the last block.
+Shapes: q (B, T, H, hs) with H = KVH * G (GQA group, ref kvMul:
+src/llama2-tasks.cpp:60), reshaped here to (B*KVH, T*G, hs) row panels;
+k/v cache (B, KVH, S, hs). Grid is (B*KVH, S/SB) with the sequence
+dimension innermost: scratch acc/m/l carry the online-softmax state across
+S blocks of the same head (flash decomposition), reset at block 0 and
+finalized at the last block.
 
-Causality: decode attends to all cache positions s <= pos (the cache is
-already updated at the query's position); positions beyond pos — including
-cache slots not yet written — are masked with -inf before the softmax.
+Causality: query row r (= token t*G + g) attends to cache positions
+s <= pos0[b] + r//G — the cache is already updated at the chunk's
+positions; positions beyond the last query — including cache slots not yet
+written — are masked with -inf before the softmax.
 
 HBM scaling with context: pos rides in as a scalar-prefetch operand and the
-K/V index maps CLAMP the sequence-block index at the block containing pos —
-Mosaic skips the DMA when consecutive grid steps map to the same block, so
-the kernel reads ~pos bytes of cache, not the full preallocated seq_len
-(at 7B/seq 2048 that dead read was ~1 GB/token early in a session); the
-repeated block's scores are fully masked, and a pl.when skips its compute.
+K/V index maps CLAMP the sequence-block index at the block containing the
+chunk's LAST query position — Mosaic skips the DMA when consecutive grid
+steps map to the same block, so the kernel reads ~pos bytes of cache, not
+the full preallocated seq_len (at 7B/seq 2048 that dead read was
+~1 GB/token early in a session); the repeated block's scores are fully
+masked, and a pl.when skips its compute.
 """
 
 from __future__ import annotations
@@ -37,10 +44,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEF_BLOCK_S = 512
 NEG_INF = -1e30
+# cap on T*G query rows per head panel: bounds the (rows, SB) f32 score tile
+# in VMEM (1024x512x4 = 2 MB; acc another 512 KB). Prefill chunks above it
+# fall back to the dense path — the engine's default chunk (256) stays under
+# for G <= 4
+MAX_Q_ROWS = 1024
 
 
 def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
-            *, sb, n_sb, kvh, scale, out_dtype):
+            *, sb, n_sb, kvh, t, g, scale, out_dtype):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -50,13 +62,13 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     b = pl.program_id(0) // kvh
-    pos = pos_ref[b]
+    pos = pos_ref[b]  # first query row's absolute position
 
-    # blocks entirely past pos are fully masked: their K/V DMA was clamped
-    # away (see index maps) and their compute is skipped
-    @pl.when(j * sb <= pos)
+    # blocks entirely past the last query position are fully masked: their
+    # K/V DMA was clamped away (see index maps) and their compute is skipped
+    @pl.when(j * sb <= pos + t - 1)
     def _accumulate():
-        q = q_ref[0]                               # (G, hs)
+        q = q_ref[0]                               # (T*G, hs)
         k = k_ref[0]                               # (SB, hs)
         v = v_ref[0]
         if k.dtype != q.dtype:
@@ -70,15 +82,18 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT,
         )
-        scores = dot(q, k, dimension_numbers=(((1,), (1,)), ((), ()))) * scale  # (G, SB)
+        scores = dot(q, k, dimension_numbers=(((1,), (1,)), ((), ()))) * scale  # (T*G, SB)
 
+        # causal: row r is query token r//G at absolute position pos + r//G
+        row_pos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // g
         s_pos = j * sb + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(s_pos <= pos, scores, NEG_INF)
+        scores = jnp.where(s_pos <= row_pos, scores, NEG_INF)
 
-        m_prev = m_ref[:]                          # (G, 1)
+        m_prev = m_ref[:]                          # (T*G, 1)
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)                # (G, SB); masked cols underflow to 0
+        p = jnp.exp(scores - m_new)                # (T*G, SB); masked cols underflow to 0
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = dot(p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())))
         acc_ref[:] = acc_ref[:] * alpha + pv
@@ -99,22 +114,28 @@ def _block_s(s: int) -> int:
     return s
 
 
+def flash_supported(t: int, h: int, kvh: int) -> bool:
+    """Kernel precondition: the (T*G, SB) score tile must fit the VMEM
+    budget. T == 1 (decode) always qualifies."""
+    return t * (h // kvh) <= MAX_Q_ROWS
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def flash_decode_attention(
-    q: jnp.ndarray,        # (B, T=1, H, hs)
+def flash_attention(
+    q: jnp.ndarray,        # (B, T, H, hs) — rotated queries
     k_cache: jnp.ndarray,  # (B, KVH, S, hs)
     v_cache: jnp.ndarray,  # (B, KVH, S, hs)
-    q_pos: jnp.ndarray,    # (B, T=1) absolute position of the query token
+    q_pos: jnp.ndarray,    # (B, T) absolute position of each query token
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Single-position decode attention; returns (B, 1, H, hs).
-
-    Matches ops/attention.decode_attention semantics for T == 1.
-    """
+    """Causal attention of T query tokens against the cache; returns
+    (B, T, H, hs). Matches ops/attention.decode_attention semantics —
+    q_pos rows must be contiguous (pos0[b] + arange(T), which is how every
+    engine path builds them — models/transformer.forward)."""
     b, t, h, hs = q.shape
-    assert t == 1, "flash decode is T=1; prefill uses decode_attention/ring"
     kvh, s = k_cache.shape[1], k_cache.shape[2]
     g = h // kvh
+    assert flash_supported(t, h, kvh), (t, g)
     sb = _block_s(s)
     n_sb = s // sb
 
@@ -126,39 +147,55 @@ def flash_decode_attention(
 
     if not is_narrow_cache(k_cache.dtype):
         q = q.astype(k_cache.dtype)
-    qh = q.reshape(b, kvh, g, hs).reshape(b * kvh, g, hs)
+    # (B, T, KVH, G, hs) -> (B*KVH, T*G, hs) row panels, one per kv head
+    qh = (q.reshape(b, t, kvh, g, hs).transpose(0, 2, 1, 3, 4)
+          .reshape(b * kvh, t * g, hs))
     kh = k_cache.reshape(b * kvh, s, hs)
     vh = v_cache.reshape(b * kvh, s, hs)
     pos = q_pos[:, 0].astype(jnp.int32)
 
     def kv_index(i, j, pos_ref):
-        # clamp at the block containing pos[b]: steps past it re-map to the
-        # same block, so Mosaic elides their HBM copy (the dead-read fix)
-        return (i, jnp.minimum(j, pos_ref[i // kvh] // sb), 0)
+        # clamp at the block containing the chunk's last query position:
+        # steps past it re-map to the same block, so Mosaic elides their HBM
+        # copy (the dead-read fix)
+        return (i, jnp.minimum(j, (pos_ref[i // kvh] + t - 1) // sb), 0)
 
     out = pl.pallas_call(
         functools.partial(
-            _kernel, sb=sb, n_sb=n_sb, kvh=kvh,
+            _kernel, sb=sb, n_sb=n_sb, kvh=kvh, t=t, g=g,
             scale=1.0 / (hs ** 0.5), out_dtype=q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * kvh, n_sb),
             in_specs=[
-                pl.BlockSpec((1, g, hs), lambda i, j, p: (i, 0, 0)),
+                pl.BlockSpec((1, t * g, hs), lambda i, j, p: (i, 0, 0)),
                 pl.BlockSpec((1, sb, hs), kv_index),
                 pl.BlockSpec((1, sb, hs), kv_index),
             ],
-            out_specs=pl.BlockSpec((1, g, hs), lambda i, j, p: (i, 0, 0)),
+            out_specs=pl.BlockSpec((1, t * g, hs), lambda i, j, p: (i, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((g, hs), jnp.float32),
-                pltpu.VMEM((g, 1), jnp.float32),
-                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((t * g, hs), jnp.float32),
+                pltpu.VMEM((t * g, 1), jnp.float32),
+                pltpu.VMEM((t * g, 1), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b * kvh, g, hs), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * kvh, t * g, hs), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(pos, qh, kh, vh)
 
-    return out.reshape(b, h, hs)[:, None]
+    return (out.reshape(b, kvh, t, g, hs).transpose(0, 2, 1, 3, 4)
+            .reshape(b, t, h, hs))
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,        # (B, T=1, H, hs)
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_pos: jnp.ndarray,    # (B, 1)
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-position decode attention — the T=1 case of flash_attention
+    (kept as a named entry point: decode is the latency-critical path)."""
+    return flash_attention(q, k_cache, v_cache, q_pos, interpret=interpret)

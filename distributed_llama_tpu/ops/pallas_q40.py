@@ -82,15 +82,17 @@ def _f16_bits_to_f32(u: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(e == 0, sub, normal)
 
 
-def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
-            *, nb, out_dtype, scales_u16, mxu_bf16):
-    pk = packed_ref[:].astype(jnp.int32)                 # (TD, M=16*nb)
+def _dequant_dot(x_lo, x_hi, xsum, pk_u8, s_raw,
+                 *, out_dtype, scales_u16, mxu_bf16):
+    """The kernel math on loaded blocks: dequantize a (TD, M) packed tile in
+    registers and contract with the pre-split activations."""
+    pk = pk_u8.astype(jnp.int32)                         # (TD, M=16*nb)
     lo = (pk & 0xF).astype(jnp.float32)
     hi = (pk >> 4).astype(jnp.float32)
     if scales_u16:
-        s = _f16_bits_to_f32(scales_ref[:].astype(jnp.int32))  # (TD, NB)
+        s = _f16_bits_to_f32(s_raw.astype(jnp.int32))    # (TD, NB)
     else:
-        s = scales_ref[:]                                # f32 (hand-built)
+        s = s_raw                                        # f32 (hand-built)
     s16 = pltpu.repeat(s, 16, axis=1)                    # lane-tile -> (TD, M)
 
     # DEFAULT precision: single-pass MXU feed (HIGHEST = multi-pass f32
@@ -103,7 +105,6 @@ def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
         precision=jax.lax.Precision.DEFAULT,
     )
     wl, wh = lo * s16, hi * s16
-    x_lo, x_hi = x_lo_ref[:], x_hi_ref[:]
     if mxu_bf16:
         # multi-token (prefill) chunks are MXU-bound: f32 feeds cap the MXU
         # at 1/4 of its bf16 rate (v5e 49 vs 197 TFLOP/s), so cast the
@@ -114,8 +115,24 @@ def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
         x_lo, x_hi = x_lo.astype(jnp.bfloat16), x_hi.astype(jnp.bfloat16)
     acc = dot(x_lo, wl)                                  # (T, TD)
     acc += dot(x_hi, wh)
-    acc += dot(xsum_ref[:], s) * -8.0                    # fold every (nib-8) offset
-    out_ref[:] = acc.astype(out_dtype)
+    acc += dot(xsum, s) * -8.0                           # fold every (nib-8) offset
+    return acc.astype(out_dtype)
+
+
+def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
+            *, nb, out_dtype, scales_u16, mxu_bf16):
+    out_ref[:] = _dequant_dot(
+        x_lo_ref[:], x_hi_ref[:], xsum_ref[:], packed_ref[:], scales_ref[:],
+        out_dtype=out_dtype, scales_u16=scales_u16, mxu_bf16=mxu_bf16)
+
+
+def _expert_kernel(e_ref, x_lo_ref, x_hi_ref, xsum_ref, packed_ref,
+                   scales_ref, out_ref, *, nb, out_dtype, scales_u16,
+                   mxu_bf16):
+    del e_ref  # consumed by the index maps (expert selection)
+    out_ref[:] = _dequant_dot(
+        x_lo_ref[:], x_hi_ref[:], xsum_ref[:], packed_ref[0], scales_ref[0],
+        out_dtype=out_dtype, scales_u16=scales_u16, mxu_bf16=mxu_bf16)
 
 
 def _tile_d(d: int, m: int) -> int:
@@ -200,5 +217,69 @@ def q40_matmul(
         ),
         interpret=interpret,
     )(x_lo, x_hi, xsum, packed2d, scales)
+
+    return out.reshape(*lead, d)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def q40_expert_matmul(
+    x: jnp.ndarray,
+    w: QuantizedTensor,    # stacked (E, d, m) packed / (E, d, nb) scales
+    e: jnp.ndarray,        # traced i32 expert index
+    out_dtype=jnp.float32,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """y[..., d] = sum_n x[..., n] * W[e, d, n] with the expert chosen by a
+    TRACED index — the MoE decode gather (models/transformer._moe_ffn; the
+    reference computes just the active experts the same way, ref:
+    src/grok1-tasks.cpp:128-143).
+
+    The expert index rides in as a scalar-prefetch operand and the block
+    index maps offset straight into the (E, d, m) HBM stack, so the kernel
+    reads the active expert's packed bytes IN PLACE. The alternative —
+    lax.dynamic_index_in_dim then q40_matmul — materializes a full HBM copy
+    of the expert's weight before the kernel can read it (read + write +
+    re-read = 3x the bytes of the decode-critical path).
+    """
+    n_e, d, m = w.packed.shape
+    nb = m // 16
+    n = nb * 32
+
+    lead = x.shape[:-1]
+    t = 1
+    for s in lead:
+        t *= s
+    x_lo, x_hi = _split_activation(x.reshape(t, n).astype(jnp.float32), nb)
+    xsum = (x_lo + x_hi).reshape(t, 16, nb).sum(axis=1)
+
+    td = _tile_d(d, m)
+    scales_u16 = w.scales.dtype == jnp.uint16
+    scales = w.scales if scales_u16 else w.scales.astype(jnp.float32)
+    mxu_bf16 = jnp.dtype(out_dtype) == jnp.bfloat16 and t >= 16
+    e_arr = jnp.atleast_1d(e).astype(jnp.int32)
+
+    out = pl.pallas_call(
+        functools.partial(_expert_kernel, nb=nb, out_dtype=out_dtype,
+                          scales_u16=scales_u16, mxu_bf16=mxu_bf16),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(d // td,),
+            in_specs=[
+                pl.BlockSpec((t, m), lambda i, e_ref: (0, 0)),
+                pl.BlockSpec((t, m), lambda i, e_ref: (0, 0)),
+                pl.BlockSpec((t, nb), lambda i, e_ref: (0, 0)),
+                pl.BlockSpec((1, td, m), lambda i, e_ref: (e_ref[0], i, 0)),
+                pl.BlockSpec((1, td, nb), lambda i, e_ref: (e_ref[0], i, 0)),
+            ],
+            out_specs=pl.BlockSpec((t, td), lambda i, e_ref: (0, i)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, d), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t * d * n,
+            bytes_accessed=d * m + d * nb * 2 + 2 * t * m * 4 + t * d * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(e_arr, x_lo, x_hi, xsum, w.packed, scales)
 
     return out.reshape(*lead, d)

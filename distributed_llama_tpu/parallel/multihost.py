@@ -182,8 +182,9 @@ def check_config(fingerprint: list[int]) -> None:
         raise SystemExit(
             f"cluster config mismatch: rank 0 has {list(allfp[0])}, "
             f"rank(s) {bad} differ (mine: {list(mine)}) — every process "
-            "must use the same --tp/--dp/--sp/--ep/--pp, dtype, seq-len, "
-            "pallas and sampler flags")
+            "must use the same MODEL (.m) and TOKENIZER (.t) files and the "
+            "same --tp/--dp/--sp/--ep/--pp, dtype, seq-len, pallas and "
+            "sampler flags")
 
 
 def broadcast_seed(seed: int) -> int:

@@ -85,16 +85,13 @@ def _leaf_spec(name: str, w):
     from .tp_q80 import TpColWeight, TpRowWeight, tp_col_pspec, tp_row_pspec
 
     if isinstance(w, PpWeight):
-        # pipeline mode: stage axis on pp, the weight's usual tp split on
-        # the remaining dims (parallel/pp.py)
-        if isinstance(w.w, QuantizedTensor):
-            return PpWeight(QuantizedTensor(
-                P(PP_AXIS, *_pspec_for(name, w.w.packed.ndim - 1, True,
-                                       "packed")),
-                P(PP_AXIS, *_pspec_for(name, w.w.scales.ndim - 1, True,
-                                       "scales"))))
-        return PpWeight(P(PP_AXIS, *_pspec_for(name, w.w.ndim - 1, False,
-                                               "dense")))
+        # pipeline mode: stage axis on pp, the weight's usual tp split (or
+        # its Tp wrapper's stack layout) on the remaining dims — the ONE
+        # spec source shared with the manual region's in_specs, so entering
+        # the region moves no bytes (parallel/pp.py)
+        from .pp import _leaf_in_spec
+
+        return _leaf_in_spec(name, w, TP_AXIS)
     if isinstance(w, (EpRowWeight, EpColWeight)):
         # expert-parallel mode: expert axis on ep (parallel/ep_moe.py)
         return ep_pspec(w)
@@ -176,9 +173,12 @@ def repack_col_weights(params: dict, tp: int) -> dict:
 
     def repack(v):
         from .ep_moe import EpColWeight
+        from .pp import PpWeight
 
-        # already repacked (streamed loader) or owned by the ep path
-        if isinstance(v, (TpColWeight, EpColWeight)):
+        # already repacked (streamed loader) or owned by the ep path; a
+        # PpWeight is the streamed loader's stage stack, whose q40 col
+        # leaves it repacked at build time (models/loader._PpStacker)
+        if isinstance(v, (TpColWeight, EpColWeight, PpWeight)):
             return v
         return repack_col_tp(v, tp)
 

@@ -133,21 +133,21 @@ def tp_row_matmul(
 
 
 def tp_flash_attention(
-    q: jnp.ndarray,        # (B, 1, H, hs)
+    q: jnp.ndarray,        # (B, T, H, hs)
     k_cache: jnp.ndarray,  # (B, KVH, S, hs)
     v_cache: jnp.ndarray,  # (B, KVH, S, hs)
-    q_pos: jnp.ndarray,    # (B, 1)
+    q_pos: jnp.ndarray,    # (B, T)
     mesh,
     *,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """flash_decode_attention over a (dp, tp) mesh: batch shards on dp,
-    heads/kv-heads on tp (the reference's KvCacheSlice head split,
-    ref: src/transformer.cpp:161-171). Pure shard-local — attention never
-    mixes heads, so no collective is needed."""
+    """flash_attention (decode and chunked prefill) over a (dp, tp) mesh:
+    batch shards on dp, heads/kv-heads on tp (the reference's KvCacheSlice
+    head split, ref: src/transformer.cpp:161-171). Pure shard-local —
+    attention never mixes heads, so no collective is needed."""
     from jax import shard_map
 
-    from ..ops.pallas_attention import flash_decode_attention
+    from ..ops.pallas_attention import flash_attention
 
     b = q.shape[0]
     dp = mesh.shape.get(DP_AXIS, 1)
@@ -156,8 +156,7 @@ def tp_flash_attention(
     tp_ax = TP_AXIS if tp > 1 else None
 
     def body(q_l, k_l, v_l, pos_l):
-        return flash_decode_attention(q_l, k_l, v_l, pos_l,
-                                      interpret=interpret)
+        return flash_attention(q_l, k_l, v_l, pos_l, interpret=interpret)
 
     fn = shard_map(
         body, mesh=mesh,
@@ -200,15 +199,30 @@ def tp_col_pspec(w: TpColWeight):
 
 
 def take_expert_col(w: TpColWeight, e) -> TpColWeight:
-    """Select expert e from a stacked (tp, E, d, n/tp) MoE col weight."""
+    """Select expert e from a stacked MoE col weight: (tp, E, d, n/tp) on the
+    GSPMD path, or the shard-local (E, d, n/tp) form inside a fully-manual
+    region (parallel/pp.py strips the tp stack axis) — discriminated by
+    rank, since expert col stacks are the only 3D/4D TpColWeight leaves."""
     from jax import lax
 
     if isinstance(w.w, QuantizedTensor):
+        ax = 1 if w.w.packed.ndim == 4 else 0
         return TpColWeight(QuantizedTensor(
-            lax.dynamic_index_in_dim(w.w.packed, e, axis=1, keepdims=False),
-            lax.dynamic_index_in_dim(w.w.scales, e, axis=1, keepdims=False),
+            lax.dynamic_index_in_dim(w.w.packed, e, axis=ax, keepdims=False),
+            lax.dynamic_index_in_dim(w.w.scales, e, axis=ax, keepdims=False),
         ))
-    return TpColWeight(lax.dynamic_index_in_dim(w.w, e, axis=1, keepdims=False))
+    ax = 1 if w.w.ndim == 4 else 0
+    return TpColWeight(lax.dynamic_index_in_dim(w.w, e, axis=ax, keepdims=False))
+
+
+def manual_psum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """lax.psum for code already inside a manual region (parallel/pp.py).
+    On the CPU backend only, the payload transits in f32: XLA's CPU compiler
+    miscompiles a bf16 all-reduce inside a manual region ("Invalid binary
+    instruction opcode copy"); TPU keeps the native width."""
+    if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:
+        return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return jax.lax.psum(x, axis)
 
 
 def tp_col_matmul(

@@ -109,6 +109,63 @@ def estimate_decode_wire(
                         {k: v / 1024.0 for k, v in bd.items()})
 
 
+COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                      "all-to-all", "collective-permute")
+
+
+def per_step_op_ms(trace_dir: str, markers: tuple = COLLECTIVE_MARKERS,
+                   module_hint: str | None = None) -> list:
+    """Parse a jax.profiler trace into PER-STEP summed device time (ms) of
+    ops whose name contains any marker — the measured analogue of the
+    reference's genuinely per-token T column (ref:
+    src/apps/dllama/dllama.cpp:74-79), where `measure_allreduce_ms` is only
+    a repeated microbench constant.
+
+    A "step" is one executed XLA module (the engine's jitted forward): the
+    device plane's "XLA Modules" line has one event per execution, and each
+    op event on the "XLA Ops"/"Async XLA Ops" lines is bucketed into the
+    module span containing it. Returns one float per module execution in
+    timeline order; [] when the trace has no device plane (CPU runs) — the
+    caller falls back to the microbench."""
+    import bisect
+    import glob
+
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:  # older jax without the xplane parser
+        return []
+    files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
+    if not files:
+        return []
+    pd = ProfileData.from_file(files[-1])
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        lines = {ln.name: ln for ln in plane.lines}
+        mods = lines.get("XLA Modules")
+        if mods is None:
+            continue
+        spans = sorted(
+            (e.start_ns, e.end_ns) for e in mods.events
+            if module_hint is None or module_hint in e.name)
+        if not spans:
+            continue
+        starts = [s for s, _ in spans]
+        out = [0.0] * len(spans)
+        for ln_name in ("XLA Ops", "Async XLA Ops"):
+            ops = lines.get(ln_name)
+            if ops is None:
+                continue
+            for e in ops.events:
+                if not any(m in e.name for m in markers):
+                    continue
+                i = bisect.bisect_right(starts, e.start_ns) - 1
+                if i >= 0 and e.start_ns < spans[i][1]:
+                    out[i] += e.duration_ns / 1e6
+        return out
+    return []
+
+
 def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
                          axes: tuple = ("tp",)) -> float:
     """Time one f32 all-reduce of `payload_elems` over the given mesh axes

@@ -90,6 +90,12 @@ class Sampler:
         n = probs.shape[0]
         cutoff = (1.0 - self.topp) / (n - 1)
         cand = np.nonzero(probs >= cutoff)[0]
+        if cand.size == 0:
+            # near-uniform probs with topp < 1/n can leave no candidate
+            # (the reference would read out of bounds here); keep the
+            # (first) argmax so the nucleus is never empty — mirrored by
+            # the native twin and the device sampler
+            cand = np.array([int(np.argmax(probs))])
         order = cand[np.argsort(-probs[cand], kind="stable")]
         p = probs[order]
         cum = np.cumsum(p.astype(np.float64))

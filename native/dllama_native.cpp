@@ -216,6 +216,15 @@ int32_t dllama_sampler_sample(void* h, float* logits, int32_t n) {
     cand.reserve(256);
     for (int32_t i = 0; i < n; i++)
         if (logits[i] >= cutoff) cand.push_back(i);
+    if (cand.empty()) {
+        // near-uniform probs with topp < 1/n can leave no candidate; keep
+        // the (first) argmax so the nucleus is never empty — same fallback
+        // as the Python sampler and the device twin
+        int32_t am = 0;
+        for (int32_t i = 1; i < n; i++)
+            if (logits[i] > logits[am]) am = i;
+        cand.push_back(am);
+    }
     std::stable_sort(cand.begin(), cand.end(), [&](int32_t a, int32_t b) {
         return logits[a] > logits[b];
     });

@@ -64,6 +64,26 @@ def test_sample_token_matches_host_sampler(rng, topp):
         assert int(state[1]) == host.rng_state & 0xFFFFFFFF
 
 
+def test_topp_empty_nucleus_edge_parity():
+    """topp < 1/n with near-uniform probs leaves no cutoff candidate
+    (ADVICE r2): host, device (and native, when built) must all fall back
+    to the argmax instead of raising / silently returning the lowest-prob
+    token."""
+    n = 8
+    logits = np.full(n, 1.0, np.float32)
+    logits[5] = 1.0 + 1e-4  # a slight argmax so the fallback is observable
+    host = Sampler(n, temperature=1.0, topp=0.05, seed=9, backend="python")
+    want = host.sample(logits.copy())
+    assert want == 5
+    tok, _ = sample_token(jnp.asarray(logits), state_from_seed(9), 1.0, 0.05)
+    assert int(tok) == want
+    from distributed_llama_tpu import native
+    if native.available():
+        nat = Sampler(n, temperature=1.0, topp=0.05, seed=9,
+                      backend="native")
+        assert nat.sample(logits.copy()) == want
+
+
 def _engine(spec, host, **kw):
     params = load_params(spec, host, mode="q40", dtype=jnp.float32)
     return Engine(spec, params, compute_dtype=jnp.float32,
@@ -119,6 +139,84 @@ def test_generate_device_eos_truncation_and_continuation():
     full = _engine(spec, host_w).generate_device(
         prompt + probe[:4], 2, temperature=0.0, topp=0.9, seed=1)
     assert cont == full, (cont, full)
+
+
+def test_generate_device_early_exit_step_count():
+    """The device loop EXITS at eos instead of burning the whole budget:
+    with budget 64 and the stop token arriving 3rd, the while loop runs
+    exactly 3 device iterations (2 forwards) — not 64."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=128)
+    host_w, _ = dense_weights(spec, seed=22)
+    prompt = [1, 5, 9]
+    probe = _engine(spec, host_w).generate_device(
+        prompt, 6, temperature=0.0, topp=0.9, seed=1)
+    eos = probe[2]
+
+    eng = _engine(spec, host_w)
+    out = eng.generate_device(prompt, 64, temperature=0.0, topp=0.9, seed=1,
+                              eos_id=eos)
+    assert out == probe[:3]
+    assert eng.last_device_steps == 3
+    assert eng.pos == len(prompt) + 2  # 2 forwards ran
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_generate_batch_device_matches_independent_runs(use_mesh):
+    """Batched on-device sampling (VERDICT #5): dp=4 sampled generation
+    matches 4 independent generate_device runs per-row — each row owns a
+    device xorshift stream seeded identically."""
+    from jax.sharding import Mesh
+
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=64)
+    host_w, _ = dense_weights(spec, seed=31)
+    prompts = [[1, 5, 9], [2, 7], [11, 3, 4, 8], [6]]
+
+    kw = {}
+    if use_mesh:
+        import jax
+        from distributed_llama_tpu.parallel.mesh import make_mesh
+        kw["mesh"] = make_mesh(dp=4, tp=1)
+
+    for temp, topp, seed in ((0.0, 0.9, 3), (0.7, 0.9, 5)):
+        want = []
+        for p in prompts:
+            eng1 = _engine(spec, host_w)
+            want.append(eng1.generate_device(p, 8, temperature=temp,
+                                             topp=topp, seed=seed))
+        engb = _engine(spec, host_w, batch=4, **kw)
+        got = engb.generate_batch_device(prompts, 8, temperature=temp,
+                                         topp=topp, seed=seed)
+        assert got == want, (temp, topp)
+
+
+def test_generate_batch_device_eos_per_row():
+    """Per-row stop tokens: each row truncates at its own eos (included),
+    and the device loop exits once all rows stopped."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=64)
+    host_w, _ = dense_weights(spec, seed=32)
+    prompts = [[1, 5, 9], [2, 7]]
+
+    # find each row's greedy stream, declare row 0's 2nd token the eos
+    probe = _engine(spec, host_w, batch=2).generate_batch_device(
+        prompts, 6, temperature=0.0, topp=0.9, seed=1)
+    eos = probe[0][1]
+
+    eng = _engine(spec, host_w, batch=2)
+    got = eng.generate_batch_device(prompts, 20, temperature=0.0, topp=0.9,
+                                    seed=1, eos_id=eos)
+    want = []
+    for row in [
+        _engine(spec, host_w).generate_device(p, 20, temperature=0.0,
+                                              topp=0.9, seed=1, eos_id=eos)
+        for p in prompts
+    ]:
+        want.append(row)
+    assert got == want
+    # the loop must exit early once both rows are done, not run 20 steps
+    assert eng.last_device_steps <= max(len(r) for r in got) + 1
 
 
 def test_cli_device_sampling_matches_host(tmp_path, capsys):

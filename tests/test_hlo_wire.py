@@ -1,0 +1,155 @@
+"""The wire model vs the compiled HLO (VERDICT r2 #9).
+
+`netstats.estimate_decode_wire` is a hand model of which collectives the
+sharding design makes GSPMD/shard_map emit. These tests lower a real decode
+step for the tp / sp / ep modes, count the collective ops in the optimized
+HLO, and assert the model's per-layer reduce counts match — so a sharding
+change that adds an unmodeled collective fails a test instead of silently
+skewing the S/T columns (the reference's byte counters are ground truth by
+construction, ref: src/socket.cpp:266-271; a model needs this check).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_llama_tpu.models import ArchType
+from distributed_llama_tpu.models.params import load_params
+from distributed_llama_tpu.models.transformer import KVCache, forward
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.parallel.sharding import cache_pspec, shard_params
+from distributed_llama_tpu.runtime.netstats import estimate_decode_wire
+
+from test_model_forward import make_spec, dense_weights
+
+
+def _collective_counts(hlo: str) -> dict:
+    """Occurrences of each collective op kind in optimized HLO text."""
+    out = {}
+    for kind in ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+                 "collective-permute"):
+        # op applications only: "kind(" or "kind-start(" — not fusion names
+        out[kind] = len(re.findall(rf"= \S+ {kind}(?:-start)?\(", hlo))
+    return out
+
+
+def _lowered_decode_hlo(spec, params, mesh, **fwd_kw) -> str:
+    cache = KVCache.create(spec, batch=1, seq_len=spec.seq_len,
+                           dtype=jnp.float32)
+    cache = jax.device_put(cache, NamedSharding(
+        mesh, cache_pspec(sp=mesh.shape.get("sp", 1) > 1)))
+    tok = jnp.zeros((1, 1), jnp.int32)
+
+    def step(params, tok, cache):
+        logits, cache = forward(params, spec, tok, jnp.int32(3), cache,
+                                compute_dtype=jnp.float32, **fwd_kw)
+        return logits, cache
+
+    fn = jax.jit(step, out_shardings=(NamedSharding(mesh, P()), None))
+    return fn.lower(params, tok, cache).compile().as_text()
+
+
+def test_tp_decode_collectives_match_model():
+    """GSPMD tp: the model says 2 partial-sum reduces per layer (wo, w2 —
+    the reference's 2 broadcast + 2 gather pairs, SURVEY.md §3.4) plus one
+    logits gather for the vocab-sharded wcls."""
+    spec = make_spec(ArchType.LLAMA)
+    host, _ = dense_weights(spec)
+    mesh = make_mesh(tp=2, dp=1)
+    params = shard_params(load_params(spec, host, mode="dense",
+                                      dtype=jnp.float32), mesh)
+    hlo = _lowered_decode_hlo(spec, params, mesh)
+    c = _collective_counts(hlo)
+
+    est = estimate_decode_wire(spec, mesh)
+    assert "tp_partial_sums" in est.breakdown
+    # the modeled per-layer reduces appear as all-reduce (or an equivalent
+    # reduce-scatter + all-gather split) — count reduce-ish ops. The
+    # vocab-sharded wcls logits replication is one extra collective: an
+    # all-gather, or an all-reduce if XLA folds it (then reduces = 2L + 1)
+    reduces = c["all-reduce"] + c["reduce-scatter"]
+    assert reduces in (2 * spec.n_layers, 2 * spec.n_layers + 1), c
+    if reduces == 2 * spec.n_layers:
+        assert c["all-gather"] >= 1, c
+
+
+def test_sp_decode_collectives_match_model():
+    """sp-sharded cache decode: one attention stat merge (psum) per layer
+    (parallel/ring_attention.sp_cache_attention), plus the tp reduces when
+    tp > 1 and the final logits gather."""
+    spec = make_spec(ArchType.LLAMA)
+    host, _ = dense_weights(spec)
+    mesh = make_mesh(tp=2, sp=2, dp=1)
+    params = shard_params(load_params(spec, host, mode="dense",
+                                      dtype=jnp.float32), mesh)
+    hlo = _lowered_decode_hlo(spec, params, mesh, sp_cache_mesh=mesh)
+    c = _collective_counts(hlo)
+
+    est = estimate_decode_wire(spec, mesh)
+    assert "sp_attn_merge" in est.breakdown
+    # per layer: 2 tp reduces + 1 sp stat merge (the merge psums acc/m/l —
+    # one fused all-reduce each if XLA keeps them separate; allow 1..3)
+    reduces = c["all-reduce"] + c["reduce-scatter"]
+    lo = 3 * spec.n_layers
+    hi = 5 * spec.n_layers + 1
+    assert lo <= reduces <= hi, (reduces, c)
+
+
+def test_ep_decode_collectives_match_model():
+    """ep x tp MoE decode: one (ep, tp)-group reduce per layer for the
+    expert sum + the attention wo reduce per layer (parallel/ep_moe.py)."""
+    spec = make_spec(ArchType.MIXTRAL)
+    host, _ = dense_weights(spec)
+    mesh = make_mesh(ep=2, tp=2, dp=1)
+    from distributed_llama_tpu.parallel.ep_moe import repack_moe_ep
+
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    params = dict(params)
+    params["layers"] = [repack_moe_ep(lw, 2) for lw in params["layers"]]
+    params = shard_params(params, mesh)
+    hlo = _lowered_decode_hlo(spec, params, mesh, tp_mesh=mesh)
+    c = _collective_counts(hlo)
+
+    est = estimate_decode_wire(spec, mesh)
+    assert "ep_moe_reduce" in est.breakdown and "tp_partial_sums" in est.breakdown
+    reduces = c["all-reduce"] + c["reduce-scatter"]
+    # per layer: 1 wo tp reduce + 1 moe (ep,tp) group reduce; logits gather
+    # may lower as a reduce too
+    lo = 2 * spec.n_layers
+    hi = 2 * spec.n_layers + 2
+    assert lo <= reduces <= hi, (reduces, c)
+
+
+def test_collective_counter_sees_known_program():
+    """Meta-check: the counter actually sees collectives. (A data-dependent
+    extra reduction is NOT a reliable probe — XLA's all-reduce combiner
+    merges independent reduces into one variadic op — so probe with known
+    standalone programs instead.)"""
+    from jax import shard_map
+
+    mesh = make_mesh(tp=2, dp=1)
+
+    @jax.jit
+    def one_psum(x):
+        return shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+                         in_specs=P("tp"), out_specs=P(), check_vma=False)(x)
+
+    hlo = one_psum.lower(jnp.ones((2, 8))).compile().as_text()
+    c = _collective_counts(hlo)
+    assert c["all-reduce"] == 1, c
+
+    @jax.jit
+    def two_chained(x):
+        def body(v):
+            a = jax.lax.psum(v, "tp")
+            return jax.lax.psum(a * a, "tp")  # data-dependent: no combining
+        return shard_map(body, mesh=mesh, in_specs=P("tp"), out_specs=P(),
+                         check_vma=False)(x)
+
+    hlo2 = two_chained.lower(jnp.ones((2, 8))).compile().as_text()
+    c2 = _collective_counts(hlo2)
+    assert c2["all-reduce"] == 2, c2

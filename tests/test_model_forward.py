@@ -93,6 +93,24 @@ def test_q40_params_close_to_dense():
     assert np.corrcoef(np.asarray(l_dense).ravel(), np.asarray(l_q40).ravel())[0, 1] > 0.98
 
 
+def test_moe_decode_fused_expert_path_matches_xla():
+    """MoE decode with the expert-indexed Pallas kernels (interpret mode)
+    must match the plain XLA gather path token for token."""
+    spec = make_spec(ArchType.MIXTRAL)
+    host, _ = dense_weights(spec, seed=4)
+    params = load_params(spec, host, mode="q40")
+
+    cache_a = KVCache.create(spec, batch=1)
+    cache_b = KVCache.create(spec, batch=1)
+    for pos, tok in enumerate([3, 17, 42, 7]):
+        t = jnp.array([[tok]], jnp.int32)
+        a, cache_a = forward(params, spec, t, jnp.int32(pos), cache_a)
+        b, cache_b = forward(params, spec, t, jnp.int32(pos), cache_b,
+                             use_pallas=True, pallas_interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=2e-4)
+
+
 def test_activation_q80_path_runs():
     """Q80 activation round-trip (wire-compression parity feature) stays close
     to the f32 path (ref quantizes activations between all steps)."""

@@ -1,11 +1,13 @@
-"""Flash-decode attention kernel vs the XLA decode_attention oracle."""
+"""Flash attention kernel (decode + chunked prefill) vs the XLA
+decode_attention oracle."""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from distributed_llama_tpu.ops.attention import decode_attention
-from distributed_llama_tpu.ops.pallas_attention import flash_decode_attention
+from distributed_llama_tpu.ops.pallas_attention import (
+    flash_attention, flash_decode_attention, flash_supported)
 
 
 @pytest.mark.parametrize("b,h,kvh,s,pos", [
@@ -27,6 +29,53 @@ def test_flash_decode_matches_oracle(b, h, kvh, s, pos):
     got = flash_decode_attention(q, k, v, q_pos, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("b,h,kvh,s,t,pos0", [
+    (1, 8, 8, 256, 16, 0),     # prefill chunk from 0, MHA
+    (1, 8, 2, 256, 16, 100),   # GQA group 4, mid-session chunk
+    (2, 8, 4, 512, 32, 37),    # batch, multiple s-blocks
+    (1, 4, 4, 384, 8, 300),    # 128-wide blocks, chunk near the cache edge
+])
+def test_flash_prefill_matches_oracle(b, h, kvh, s, t, pos0):
+    """T>1 chunks: per-row causal limits must match the dense masked path.
+    The cache is pre-filled at the chunk's positions (the engine writes K/V
+    before attending — models/transformer._attention_block)."""
+    hs = 128
+    rng = np.random.default_rng(pos0 + s + h + t)
+    q = jnp.asarray(rng.standard_normal((b, t, h, hs)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, hs)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, hs)), jnp.float32)
+    q_pos = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q_pos = jnp.broadcast_to(q_pos, (b, t))
+
+    want = decode_attention(q, k, v, q_pos)
+    got = flash_attention(q, k, v, q_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_prefill_per_row_pos0():
+    """Batched generation decodes with per-row positions; the kernel reads
+    each panel's own pos_ref[b]."""
+    b, t, h, kvh, s, hs = 3, 1, 4, 4, 256, 128
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((b, t, h, hs)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, hs)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, hs)), jnp.float32)
+    q_pos = jnp.asarray([[3], [100], [255]], jnp.int32)
+
+    want = decode_attention(q, k, v, q_pos)
+    got = flash_attention(q, k, v, q_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_supported_bounds():
+    assert flash_supported(1, 32, 8)        # decode always
+    assert flash_supported(256, 32, 32)     # 7B chunk: 256 rows
+    assert flash_supported(256, 32, 8)      # 8B chunk: 1024 rows
+    assert not flash_supported(512, 32, 8)  # 2048 rows > VMEM budget
 
 
 def test_flash_decode_bf16():

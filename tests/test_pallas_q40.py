@@ -44,6 +44,53 @@ def test_leading_dims_flattened(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("e", [0, 2, 7])
+def test_expert_kernel_matches_sliced_oracle(rng, e):
+    """The expert-indexed kernel (traced index into the (E, d, m) stack) must
+    match slicing the expert out first then running the plain kernel path."""
+    from distributed_llama_tpu.ops.pallas_q40 import q40_expert_matmul
+
+    n_e, d, n = 8, 256, 1024
+    qts = [_qt(rng, d, n) for _ in range(n_e)]
+    stack = QuantizedTensor(jnp.stack([q.packed for q in qts]),
+                            jnp.stack([q.scales for q in qts]))
+    x = jnp.asarray(rng.standard_normal((1, n), dtype=np.float32))
+    ref = jnp.einsum("tn,dn->td", x,
+                     dequantize_q40_jax(qts[e], dtype=jnp.float32))
+    got = q40_expert_matmul(x, stack, jnp.int32(e), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_fused_expert_matmul_dispatch(rng):
+    """ops/matmul.fused_expert_matmul: eligible only for single-shard Q40
+    stacks under use_pallas; returns the same result as gather-then-matmul."""
+    from distributed_llama_tpu.ops.matmul import fused_expert_matmul
+
+    n_e, d, n = 4, 128, 256
+    qts = [_qt(rng, d, n) for _ in range(n_e)]
+    stack = QuantizedTensor(jnp.stack([q.packed for q in qts]),
+                            jnp.stack([q.scales for q in qts]))
+    x = jnp.asarray(rng.standard_normal((1, 1, n), dtype=np.float32))
+    got = fused_expert_matmul(x, stack, jnp.int32(3),
+                              compute_dtype=jnp.float32, use_pallas=True,
+                              pallas_interpret=True)
+    assert got is not None and got.shape == (1, 1, d)
+    ref = jnp.einsum("btn,dn->btd", x,
+                     dequantize_q40_jax(qts[3], dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=1e-4)
+    # ineligible: pallas off, mesh path, dense leaf, 2D (un-stacked) weight
+    assert fused_expert_matmul(x, stack, 0, compute_dtype=jnp.float32) is None
+    assert fused_expert_matmul(x, stack, 0, compute_dtype=jnp.float32,
+                               use_pallas=True, tp_mesh=object()) is None
+    assert fused_expert_matmul(x, jnp.zeros((4, d, n)), 0,
+                               compute_dtype=jnp.float32,
+                               use_pallas=True) is None
+    assert fused_expert_matmul(x, qts[0], 0, compute_dtype=jnp.float32,
+                               use_pallas=True) is None
+
+
 def test_supports_and_tiles():
     assert _tile_d(4096, 2048) == 1024
     assert _tile_d(4096, 5504) == 256     # w2: bigger m, smaller tile
