@@ -215,15 +215,16 @@ def _measure_prefill(engine, n_prompt: int, repeats: int) -> float:
 
 
 def _variant_rows(engine, params, spec: ModelSpec, repeats: int) -> list[dict]:
-    """Extra measured rows for the default 7b run: prefill throughput,
-    8k-fill long-context decode (bf16 and fp8 caches — the documented ~1.6x
-    fp8 attention tax as a measured artifact), and Mixtral-shaped MoE decode
-    (the expert-gather path, ops/pallas_q40.q40_expert_matmul)."""
+    """Extra measured rows for the default 7b run: prefill throughput and
+    8k-fill long-context decode (bf16 and fp8 caches — the documented fp8
+    attention tax as a measured artifact)."""
     import gc
 
     rows = []
     n_pre = 2048
-    tok_s = _measure_prefill(engine, n_pre, repeats)
+    # prefill runs are short (~0.4 s) and tunnel jitter is ±30%: extra
+    # repeats are nearly free and tighten the best-of-N
+    tok_s = _measure_prefill(engine, n_pre, max(repeats, 4))
     rows.append({
         "metric": "llama2_7b_q40_prefill_2048_tok_per_s",
         "value": round(tok_s, 1), "unit": "tok/s", "vs_baseline": None})
@@ -239,6 +240,15 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int) -> list[dict]:
             cache_itemsize=jnp.dtype(cdt).itemsize))
         del eng
         gc.collect()
+    return rows
+
+
+def _moe_row(repeats: int) -> dict:
+    """Mixtral-shaped MoE decode (the expert-gather path,
+    ops/pallas_q40.q40_expert_matmul). Runs with the chip to itself —
+    callers must drop the 7b engine/params first (a resident 3.9 GB
+    neighbor measured ~25% off the standalone bandwidth)."""
+    import gc
 
     moe_params = synth_q40_params(MIXTRAL_MOE)
     eng = Engine(MIXTRAL_MOE, moe_params, compute_dtype=jnp.bfloat16,
@@ -249,10 +259,9 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int) -> list[dict]:
     # per-layer cost extrapolates to full-depth Mixtral/Grok (decode cost is
     # layer-linear; wcls/embedding amortize further at 32 layers)
     row["ms_per_token_per_layer"] = round(msm / MIXTRAL_MOE.n_layers, 4)
-    rows.append(row)
     del eng, moe_params
     gc.collect()
-    return rows
+    return row
 
 
 def main() -> None:
@@ -303,7 +312,12 @@ def main() -> None:
     defaults = (model == "7b" and fill == 0 and seq == 2048
                 and cache_dtype == jnp.bfloat16)
     if defaults and os.environ.get("BENCH_VARIANTS", "1") != "0":
+        import gc
+
         out["variants"] = _variant_rows(engine, params, spec, repeats)
+        del engine, params  # free the 7b weights before the MoE row
+        gc.collect()
+        out["variants"].append(_moe_row(repeats))
 
     print(json.dumps(out))
 

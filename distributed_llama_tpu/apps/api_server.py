@@ -49,7 +49,8 @@ def build_chat_prompt(messages: list[dict]) -> str:
 
 
 class ApiState:
-    def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama"):
+    def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama",
+                 lookup_decode: int = 0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -57,6 +58,9 @@ class ApiState:
         # token history whose K/V writes are live in the engine cache
         # (prefix/session reuse — see _completion_chunks)
         self.cached_tokens: list[int] = []
+        # greedy requests draft+verify up to this many tokens per forward
+        # (prompt-lookup speculation, runtime/speculative.py); 0 = off
+        self.lookup_decode = lookup_decode
 
 
 def _completion_chunks(state: ApiState, body: dict):
@@ -121,11 +125,34 @@ def _completion_chunks(state: ApiState, body: dict):
                    + [len(s) for s in stops] + [1]) + 16
     emitted = 0
     finish = "length"
-    try:
+    def plain_tokens():
+        """Reference-parity sampled loop as a token iterator: yield, then
+        step the token only if the consumer pulls again (so the last
+        emitted token is never stepped — same as the host generate())."""
         logits = engine.prefill(suffix)
-        history = list(tokens)  # every prompt position is now written
         for _ in range(n_gen):
             tok = sampler.sample(engine.fetch_logits(logits)[0])
+            yield tok
+            if engine.pos >= engine.seq_len:
+                return
+            logits = engine.step(np.asarray([[tok]], np.int32), engine.pos)
+            history.append(tok)  # stepping tok wrote its K/V
+
+    # greedy requests can speculate: prompt-lookup drafts verified in one
+    # forward (exact greedy stream — runtime/speculative.py). Single-process
+    # only, like the prefix reuse above.
+    use_lookup = (state.lookup_decode > 0 and sampler.temperature == 0.0
+                  and jax.process_count() == 1)
+    history = list(tokens)  # every prompt position is written by prefill
+    try:
+        if use_lookup:
+            token_iter = engine.generate_lookup_stream(
+                suffix, n_gen, history=tokens,
+                draft_len=state.lookup_decode,
+                vocab_size=tokenizer.vocab_size)
+        else:
+            token_iter = plain_tokens()
+        for tok in token_iter:
             if tok == tokenizer.eos_id:
                 finish = "stop"
                 break
@@ -140,11 +167,9 @@ def _completion_chunks(state: ApiState, body: dict):
                 finish = "stop"
                 break
             emitted += 1
+            if use_lookup:
+                history.append(tok)  # its K/V position is already written
             yield ("piece", piece)
-            if engine.pos >= engine.seq_len:
-                break
-            logits = engine.step(np.asarray([[tok]], np.int32), engine.pos)
-            history.append(tok)  # stepping tok wrote its K/V
         state.cached_tokens = history[: engine.pos]
     finally:
         sampler.set_temp(saved_temp)
@@ -288,7 +313,8 @@ def serve(args) -> None:
     from .dllama import build_engine
 
     engine, tokenizer, sampler = build_engine(args)
-    state = ApiState(engine, tokenizer, sampler)
+    state = ApiState(engine, tokenizer, sampler,
+                     lookup_decode=getattr(args, "lookup_decode", 0))
     server = HTTPServer((args.host, args.port), make_handler(state))
     print(f"🔌 dllama-api listening on {args.host}:{args.port}")
     try:

@@ -79,8 +79,9 @@ def build_argparser() -> argparse.ArgumentParser:
                         "memory — 2x context per device (net-new vs the "
                         "reference's f32-only cache). On TPUs without fp8 "
                         "hardware (v5e) the read-side upcast is software: "
-                        "deep-fill decode pays ~1.6x attention time, so "
-                        "prefer f8 when context memory is the binding "
+                        "measured 7B decode at 7680-deep fill is 42.2 vs "
+                        "19.0 ms/token (bench.py 8kfill rows), so prefer "
+                        "f8 only when context memory is the binding "
                         "constraint")
     p.add_argument("--pallas", action="store_true", default=None,
                    help="force the fused Pallas kernels on (default: on for "
@@ -90,6 +91,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="force the XLA dequant path instead of the Pallas "
                         "kernels")
     p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
+    p.add_argument("--session", default=None, metavar="FILE",
+                   help="chat mode: persist the KV-cache session to FILE "
+                        "after every turn and resume from it on start — a "
+                        "chat survives process restarts without "
+                        "re-prefilling its history (net-new: the reference "
+                        "has no session persistence, SURVEY.md §5.4)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the generation to DIR "
                         "(view with tensorboard/xprof; net-new — the "
@@ -102,6 +109,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "each batch row gets its own device RNG stream. "
                         "Output streams after the loop. Net-new: the "
                         "reference samples on CPU every token")
+    p.add_argument("--lookup-decode", type=int, default=0, metavar="K",
+                   help="greedy speculative decoding: draft up to K tokens "
+                        "per step from the context's own n-grams and verify "
+                        "them in ONE forward (prompt lookup — decode is "
+                        "weight-read-bound on TPU, so confirmed draft "
+                        "tokens are nearly free). Token stream is exactly "
+                        "the greedy stream; requires --temperature 0. "
+                        "Net-new: the reference is strictly 1 token/forward")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -274,6 +289,14 @@ def cmd_generate(args, benchmark: bool) -> None:
     if args.device_sampling and args.nnodes > 1:
         sys.exit("error: --device-sampling does not compose with "
                  "--nnodes (the worker protocol drives generate())")
+    if args.lookup_decode:
+        if args.temperature != 0:
+            sys.exit("error: --lookup-decode is exact for greedy decoding "
+                     "only — pass --temperature 0")
+        if args.nnodes > 1 or args.dp > 1 or args.device_sampling:
+            sys.exit("error: --lookup-decode is single-sequence host-loop "
+                     "greedy; it does not compose with --nnodes/--dp/"
+                     "--device-sampling")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
     tokens = tokenizer.encode(prompt)
@@ -329,6 +352,24 @@ def cmd_generate(args, benchmark: bool) -> None:
     def on_token(tok: int) -> None:
         _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
         prev[0] = tok
+
+    if args.lookup_decode:
+        t0 = time.time()
+        with _maybe_profile(args):
+            res = engine.generate_lookup(
+                tokens, _steps(args, engine),
+                eos_id=tokenizer.stop_token_ids(),
+                draft_len=args.lookup_decode, on_token=on_token,
+                vocab_size=tokenizer.vocab_size)
+        dt = time.time() - t0
+        print()
+        if benchmark:
+            fwd, n = engine.last_accept_stats
+            print(f"Generated tokens:    {n} in {fwd} forwards "
+                  f"({n / max(fwd, 1):.2f} tokens/forward)")
+            print(f"Wall time:           {dt:.2f} s (includes compiles for "
+                  "each distinct verify length)")
+        return
 
     _announce_run(tokens, _steps(args, engine), sampler=sampler)
     # benchmark mode on a single-process multi-device mesh: capture a trace
@@ -406,14 +447,22 @@ def _print_benchmark(args, engine, res, trace_dir=None) -> None:
 
 def cmd_chat(args) -> None:
     """Interactive chat with the Llama-2 template (ref: dllama.cpp:133-178)."""
+    import os
+
     engine, tokenizer, sampler = build_engine(args)
+    resumed = False
+    if args.session and os.path.exists(args.session):
+        engine.load_session(args.session)
+        resumed = True
+        print(f"💾 resumed session from {args.session} "
+              f"({engine.pos} cached positions)")
     system = args.system_prompt
-    if system is None:
+    if system is None and not resumed:
         try:
             system = input("💻 System prompt (optional): ")
         except EOFError:
             system = ""
-    first = True
+    first = not resumed
     while True:
         try:
             user = input("\n👱 User\n> ")
@@ -446,6 +495,8 @@ def cmd_chat(args) -> None:
         engine.generate(tokens, min(_steps(args, engine), remaining), sampler,
                         eos_id=stops, on_token=on_token)
         print()
+        if args.session:
+            engine.save_session(args.session)
 
 
 def cmd_worker(args) -> None:

@@ -241,6 +241,77 @@ class Engine:
         self.cache = self._new_cache()
         self.pos = 0
 
+    # -- session persistence ----------------------------------------------
+
+    def save_session(self, path: str) -> None:
+        """Persist the generation session — pos and the FILLED cache prefix
+        (positions < pos) — to an .npz. Net-new vs the reference, which has
+        no KV-cache persistence or session resume (SURVEY.md §5.4): a chat
+        can continue across process restarts without re-prefilling its
+        history. Narrow dtypes (bf16/fp8) are stored as raw bit patterns
+        (numpy's format cannot describe them)."""
+        assert self._pp == 1, "session save/restore does not support --pp"
+        data: dict = {
+            "pos": np.int64(self.pos),
+            "cache_dtype": np.str_(jnp.dtype(self.cache_dtype).name),
+            "config": np.asarray(self._session_fingerprint(), np.int64),
+        }
+        for l in range(self.spec.n_layers):
+            for name, leaf in (("k", self.cache.k[l]), ("v", self.cache.v[l])):
+                arr = np.asarray(leaf[:, :, : self.pos, :])
+                if arr.dtype.itemsize == 1:
+                    arr = arr.view(np.uint8)
+                elif arr.dtype not in (np.float32, np.float64):
+                    arr = arr.view(np.uint16)
+                data[f"{name}{l}"] = arr
+        # open handle: np.savez(str_path) appends ".npz" to extension-less
+        # names, which load_session/os.path.exists would then never find
+        with open(path, "wb") as f:
+            np.savez(f, **data)
+
+    def load_session(self, path: str) -> None:
+        """Restore a save_session() file: refuses a mismatched model/engine
+        config, rebuilds the cache with the saved prefix in place (sharded
+        placement included) and sets pos."""
+        assert self._pp == 1, "session save/restore does not support --pp"
+        z = np.load(path)
+        if list(z["config"]) != self._session_fingerprint():
+            raise ValueError(
+                "session file does not match this engine's model/config "
+                f"(saved {list(z['config'])}, "
+                f"engine {self._session_fingerprint()})")
+        pos = int(z["pos"])
+        assert pos <= self.seq_len
+        self.reset()
+        dt = jnp.dtype(self.cache_dtype)
+        k_all, v_all = [], []
+        for l in range(self.spec.n_layers):
+            host = {}
+            for name in ("k", "v"):
+                full = np.zeros(
+                    (self.batch, self.spec.n_kv_heads, self.seq_len,
+                     self.spec.head_size), dt)
+                full[:, :, :pos, :] = z[f"{name}{l}"].view(dt)
+                host[name] = full
+            if self._cache_sharding is not None:
+                k_all.append(jax.device_put(host["k"], self._cache_sharding))
+                v_all.append(jax.device_put(host["v"], self._cache_sharding))
+            else:
+                k_all.append(jnp.asarray(host["k"]))
+                v_all.append(jnp.asarray(host["v"]))
+        self.cache = KVCache(tuple(k_all), tuple(v_all))
+        self.pos = pos
+
+    def _session_fingerprint(self) -> list[int]:
+        import zlib
+
+        sp = self.spec
+        return [zlib.crc32(repr((sp.arch, sp.dim, sp.hidden_dim, sp.n_layers,
+                                 sp.n_heads, sp.n_kv_heads,
+                                 sp.head_size)).encode()),
+                self.batch, self.seq_len,
+                zlib.crc32(jnp.dtype(self.cache_dtype).name.encode())]
+
     # -- observability -----------------------------------------------------
 
     def wire_estimate(self):
@@ -302,11 +373,13 @@ class Engine:
         )
 
     def _compiled_step(self, key, *, sp_mesh=None,
-                       with_logit_index: bool = False) -> Callable:
+                       with_logit_index: bool = False,
+                       logits_for_all: bool = False) -> Callable:
         """One cached jitted forward wrapper for every execution path.
 
-        Two shapes share it: (params, tokens, pos, cache) with pos scalar
-        (step) or (B,) vector (batched decode), and
+        Three shapes share it: (params, tokens, pos, cache) with pos scalar
+        (step) or (B,) vector (batched decode), the same with per-position
+        logits (logits_for_all — the speculative verify forward), and
         (params, tokens, logit_index, cache) for whole-segment prefill from
         pos 0 (right-padded batch; ring when sp_mesh is set). Single builder
         so a new forward() knob is threaded exactly once."""
@@ -322,7 +395,7 @@ class Engine:
         else:
             def run(params, tokens, pos0, cache):
                 return forward(params, self.spec, tokens, pos0, cache,
-                               **common)
+                               logits_for_all=logits_for_all, **common)
 
         fn = jax.jit(run, donate_argnums=(3,))
         self._steps[key] = fn
@@ -445,6 +518,129 @@ class Engine:
             out.append(token)
             if on_token:
                 on_token(token)
+        return GenerationResult(out, stats)
+
+    # -- speculative (prompt-lookup) greedy generation --------------------
+
+    def generate_lookup_stream(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        eos_id: int | set[int] | None = None,
+        *,
+        draft_len: int = 7,
+        max_ngram: int = 3,
+        history: list[int] | None = None,
+        stats: RunStats | None = None,
+        vocab_size: int | None = None,
+    ) -> Iterator[int]:
+        """Token iterator for prompt-lookup speculative decoding
+        (runtime/speculative.py): each forward feeds the last emitted token
+        PLUS a draft continuation mined from the context's own n-grams and
+        emits one token per confirmed position — decode is weight-read-
+        bound, so the t = 1 + k verify forward costs ~one token's HBM time
+        and every accepted draft token is nearly free. The yielded stream
+        is EXACTLY generate()'s greedy stream (drafts only batch the
+        confirmation); `last_accept_stats` records (forwards, tokens) and
+        updates per forward, so an abandoned iterator leaves it accurate.
+
+        `prompt` is fed from the current self.pos (the API server's prefix
+        reuse passes only the suffix); `history` is the full token context
+        drafts are mined from (defaults to `prompt`); `vocab_size` caps the
+        argmax at the TOKENIZER's vocab like the host Sampler does — a
+        padded model head would otherwise emit undecodable ids and break
+        the exact-greedy-parity contract. Greedy only: sampled speculation
+        needs rejection resampling to stay distribution-exact — the sampled
+        paths keep 1 token/forward."""
+        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+        spec_v = min(vocab_size or self.spec.vocab_size,
+                     self.spec.vocab_size)
+
+        from .speculative import count_accepted, find_draft
+
+        t0 = time.perf_counter()
+        logits = self.prefill(prompt)
+        logits_np = self.fetch_logits(logits)
+        t1 = time.perf_counter()
+        if stats is not None:
+            stats.add(StepStats(generation_ms=(t1 - t0) * 1e3,
+                                device_ms=(t1 - t0) * 1e3))
+
+        token = int(np.argmax(logits_np[0, :spec_v]))
+        n_out = 1
+        self.last_accept_stats = (1, 1)
+        hist = np.asarray((history if history is not None else prompt)
+                          + [token], np.int32)
+        yield token
+
+        while (n_out < max_tokens and self.pos < self.seq_len
+               and token not in stop_ids):
+            # draft sized to the remaining budget/context (the +1 below is
+            # the fed token itself; its K/V write needs a free slot)
+            k = min(draft_len, self.seq_len - self.pos - 1,
+                    max_tokens - n_out - 1)
+            draft = find_draft(hist, k, max_ngram=max_ngram) if k > 0 else []
+            seg = np.asarray([[token] + draft], np.int32)
+            pos0 = self.pos
+
+            g0 = time.perf_counter()
+            fn = self._compiled_step(("lookup", seg.shape[1]),
+                                     logits_for_all=True)
+            tok_dev = jnp.asarray(seg)
+            if self._token_sharding is not None:
+                tok_dev = jax.device_put(tok_dev, self._token_sharding)
+            logits, self.cache = fn(
+                self.params, tok_dev, jnp.int32(pos0), self.cache)
+            greedy = np.argmax(self.fetch_logits(logits)[0][:, :spec_v],
+                               axis=-1)
+            g1 = time.perf_counter()
+            if stats is not None:
+                stats.add(StepStats(generation_ms=(g1 - g0) * 1e3,
+                                    device_ms=(g1 - g0) * 1e3))
+
+            m = count_accepted(draft, greedy)
+            emitted = [int(g) for g in greedy[: m + 1]]
+            # stop token: emit it (generate() parity), drop the rest
+            for i, t in enumerate(emitted):
+                if t in stop_ids:
+                    emitted = emitted[: i + 1]
+                    break
+            emitted = emitted[: max_tokens - n_out]
+            # positions pos0..pos0+a hold [token] + the confirmed draft
+            # prefix; unconfirmed draft writes beyond that are overwritten
+            # position-by-position before any later query attends them
+            # (the same invariant decode overruns rely on)
+            a = len(emitted) - 1
+            self.pos = pos0 + 1 + a
+            n_out += len(emitted)
+            self.last_accept_stats = (self.last_accept_stats[0] + 1, n_out)
+            hist = np.concatenate([hist, np.asarray(emitted, np.int32)])
+            token = emitted[-1]
+            for t in emitted:
+                yield t
+
+    def generate_lookup(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        eos_id: int | set[int] | None = None,
+        *,
+        draft_len: int = 7,
+        max_ngram: int = 3,
+        on_token: Callable[[int], None] | None = None,
+        vocab_size: int | None = None,
+    ) -> GenerationResult:
+        """Collecting wrapper over generate_lookup_stream (the CLI path)."""
+        stats = RunStats()
+        out: list[int] = []
+        for t in self.generate_lookup_stream(prompt, max_tokens, eos_id,
+                                             draft_len=draft_len,
+                                             max_ngram=max_ngram,
+                                             stats=stats,
+                                             vocab_size=vocab_size):
+            out.append(t)
+            if on_token:
+                on_token(t)
         return GenerationResult(out, stats)
 
     # -- batched generation (dp path) -------------------------------------

@@ -1,0 +1,55 @@
+"""Prompt-lookup speculative decoding — draft from the context's own
+n-grams, verify a whole draft in one forward.
+
+Net-new vs the reference (strictly one token per forward,
+ref: src/apps/dllama/dllama.cpp:43-81), and a TPU-shaped win: decode is
+weight-READ-bound, so a verify forward over t = 1 + k tokens costs almost
+the same HBM time as t = 1 — every accepted draft token is nearly free.
+The draft source is the context itself (the "prompt lookup" scheme: find
+the longest suffix n-gram that occurred earlier, propose its continuation)
+— no draft model, no extra weights, and exact greedy equivalence: emitted
+tokens are always the model's own argmaxes, drafts only decide how many
+positions one forward can confirm.
+
+Acceptance is content-dependent: repetitive text (code, extraction,
+summaries quoting the source) accepts most drafts; high-entropy text
+degrades gracefully to ~1 token/forward plus the (cheap) failed drafts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def find_draft(
+    history: np.ndarray,   # 1-D int32 token ids: prompt + emitted so far
+    draft_len: int,
+    max_ngram: int = 3,
+    min_ngram: int = 1,
+) -> list[int]:
+    """Longest-suffix n-gram match: for n = max_ngram..min_ngram, find the
+    LAST earlier occurrence of the trailing n tokens and return up to
+    draft_len tokens that followed it. [] when nothing matches."""
+    h = np.asarray(history)
+    ln = h.shape[0]
+    for n in range(max_ngram, min_ngram - 1, -1):
+        if ln < n + 1:
+            continue
+        pat = h[ln - n:]
+        win = np.lib.stride_tricks.sliding_window_view(h, n)
+        hits = np.nonzero((win == pat).all(axis=1))[0]
+        hits = hits[hits < ln - n]  # exclude the suffix itself
+        if hits.size:
+            j = int(hits[-1]) + n
+            return h[j: j + draft_len].tolist()
+    return []
+
+
+def count_accepted(draft: list[int], greedy: np.ndarray) -> int:
+    """How many leading draft tokens the verify forward confirmed: greedy[i]
+    is the model's argmax AFTER segment position i, so draft token i (fed at
+    segment position i+1) is correct iff it equals greedy[i]."""
+    m = 0
+    while m < len(draft) and int(greedy[m]) == draft[m]:
+        m += 1
+    return m

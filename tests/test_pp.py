@@ -169,6 +169,29 @@ def test_pp_streamed_loader_places_stages(tmp_path, arch):
     assert got == want, (got, want)
 
 
+def test_unfuse_inverts_fuse_exactly():
+    """Engines built at tp > 1 from a dict a tp == 1 engine fused in place
+    must see the original per-projection weights byte-for-byte (a manual
+    row split of the fused [q|k|v] dim crosses projection boundaries)."""
+    import numpy as np
+
+    from distributed_llama_tpu.models.params import (
+        fuse_layer_weights, unfuse_layer_weights)
+
+    spec, params = make_params(mode="q40")
+    lw0 = params["layers"][0]
+    orig = {k: (np.asarray(lw0[k].packed), np.asarray(lw0[k].scales))
+            for k in ("wq", "wk", "wv", "w1", "w3")}
+    fused = fuse_layer_weights(params)  # mutates in place
+    assert "wqkv" in fused["layers"][0] and "wq" not in fused["layers"][0]
+    back = unfuse_layer_weights(fused, spec)
+    for k, (pk, sc) in orig.items():
+        np.testing.assert_array_equal(np.asarray(back["layers"][0][k].packed), pk)
+        np.testing.assert_array_equal(np.asarray(back["layers"][0][k].scales), sc)
+    # no-op on an unfused dict
+    assert unfuse_layer_weights(back, spec) is back
+
+
 def test_pp_rejects_unsupported_combos():
     spec, params = make_params()
     with pytest.raises(AssertionError, match="sp"):

@@ -1,0 +1,151 @@
+"""KV-cache session persistence (Engine.save_session / load_session).
+
+Net-new vs the reference, which has no cache persistence or session resume
+(SURVEY.md §5.4 — its API server restarts generation state per request): a
+restored session must continue a generation exactly where the original
+engine would have.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models import ArchType
+from distributed_llama_tpu.models.params import load_params
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.runtime import Engine
+from distributed_llama_tpu.sampler import Sampler
+
+from test_model_forward import make_spec, dense_weights
+
+
+def greedy(v=128):
+    return Sampler(v, temperature=0.0, topp=0.9, seed=1)
+
+
+def _spec_host(seed=51, **kw):
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=64, **kw)
+    host, _ = dense_weights(spec, seed=seed)
+    return spec, host
+
+
+@pytest.mark.parametrize("cache_dtype", [jnp.float32, jnp.bfloat16])
+def test_session_roundtrip_continues_exactly(tmp_path, cache_dtype):
+    spec, host = _spec_host()
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    prompt = [1, 5, 9, 2]
+
+    eng_a = Engine(spec, params, compute_dtype=jnp.float32,
+                   cache_dtype=cache_dtype)
+    part1 = eng_a.generate(prompt, 5, greedy()).tokens
+    eng_a.save_session(str(tmp_path / "s.npz"))
+    want = eng_a.generate([part1[-1]], 5, greedy()).tokens
+
+    eng_b = Engine(spec, params, compute_dtype=jnp.float32,
+                   cache_dtype=cache_dtype)
+    eng_b.load_session(str(tmp_path / "s.npz"))
+    assert eng_b.pos == len(prompt) + len(part1) - 1
+    got = eng_b.generate([part1[-1]], 5, greedy()).tokens
+    assert got == want, (got, want)
+
+
+def test_session_extensionless_path_roundtrips(tmp_path):
+    """np.savez appends '.npz' to extension-less str paths; save_session
+    must write EXACTLY the requested path or chat --session silently never
+    resumes (the resume check uses the raw path)."""
+    import os
+
+    spec, host = _spec_host()
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    eng = Engine(spec, params, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    eng.generate([1, 5], 2, greedy())
+    path = str(tmp_path / "chat.sess")
+    eng.save_session(path)
+    assert os.path.exists(path), os.listdir(tmp_path)
+    eng2 = Engine(spec, params, compute_dtype=jnp.float32,
+                  cache_dtype=jnp.float32)
+    eng2.load_session(path)
+    assert eng2.pos == eng.pos
+
+
+def test_session_rejects_mismatched_config(tmp_path):
+    spec, host = _spec_host()
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    eng = Engine(spec, params, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    eng.generate([1, 5], 2, greedy())
+    eng.save_session(str(tmp_path / "s.npz"))
+
+    other_spec, other_host = _spec_host(n_layers=4)
+    other = Engine(other_spec,
+                   load_params(other_spec, other_host, mode="q40",
+                               dtype=jnp.float32),
+                   compute_dtype=jnp.float32, cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="does not match"):
+        other.load_session(str(tmp_path / "s.npz"))
+    # dtype mismatch is a config mismatch too (bit patterns differ)
+    f8 = Engine(spec, params, compute_dtype=jnp.float32,
+                cache_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="does not match"):
+        f8.load_session(str(tmp_path / "s.npz"))
+
+
+def test_session_restores_onto_mesh(tmp_path):
+    """A session saved on a single device restores onto a tp mesh (the
+    cache re-places with the engine's sharding) and continues exactly."""
+    spec, host = _spec_host()
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    prompt = [1, 5, 9, 2]
+
+    eng_a = Engine(spec, params, compute_dtype=jnp.float32,
+                   cache_dtype=jnp.float32)
+    part1 = eng_a.generate(prompt, 5, greedy()).tokens
+    eng_a.save_session(str(tmp_path / "s.npz"))
+    want = eng_a.generate([part1[-1]], 5, greedy()).tokens
+
+    # dense weights: the tiny spec's hidden_dim (96) cannot block-split
+    # q40 cols at tp=2; the restore path under test is the CACHE placement
+    eng_b = Engine(spec, load_params(spec, host, mode="dense",
+                                     dtype=jnp.float32),
+                   make_mesh(tp=2, dp=1),
+                   compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                   use_pallas=False)
+    eng_b.load_session(str(tmp_path / "s.npz"))
+    got = eng_b.generate([part1[-1]], 5, greedy()).tokens
+    assert got == want, (got, want)
+
+
+def test_chat_session_flag_resumes(tmp_path, capsys, monkeypatch):
+    """CLI: `chat --session FILE` saves after each turn and resumes —
+    the resumed process continues from the cached positions."""
+    from distributed_llama_tpu.apps import dllama
+    from distributed_llama_tpu.testing import write_fixture
+
+    rng = np.random.default_rng(23)
+    mpath, tpath = write_fixture(tmp_path, rng=rng, seq_len=192)
+    sess = str(tmp_path / "chat.npz")
+
+    import builtins
+
+    inputs = iter(["", "ab"])
+
+    def fake_input(*a):
+        try:
+            return next(inputs)
+        except StopIteration:
+            raise EOFError
+
+    monkeypatch.setattr(builtins, "input", fake_input)
+    dllama.main(["chat", "--model", mpath, "--tokenizer", tpath,
+                 "--steps", "3", "--seed", "7", "--temperature", "0",
+                 "--session", sess])
+    capsys.readouterr()
+
+    inputs = iter(["ba"])
+    dllama.main(["chat", "--model", mpath, "--tokenizer", tpath,
+                 "--steps", "3", "--seed", "7", "--temperature", "0",
+                 "--session", sess])
+    out = capsys.readouterr().out
+    assert "resumed session" in out
