@@ -449,7 +449,14 @@ def cmd_chat(args) -> None:
     """Interactive chat with the Llama-2 template (ref: dllama.cpp:133-178)."""
     import os
 
+    if args.lookup_decode and (args.temperature != 0 or args.nnodes > 1):
+        # same loud guard as generate mode — a silently ignored flag is
+        # worse than an error
+        sys.exit("error: --lookup-decode is exact for greedy decoding only "
+                 "(pass --temperature 0) and does not compose with --nnodes")
     engine, tokenizer, sampler = build_engine(args)
+    convo: list[int] = []  # whole-conversation tokens: the draft miner's
+    # n-gram source (chat history is full of quotable n-grams)
     resumed = False
     if args.session and os.path.exists(args.session):
         engine.load_session(args.session)
@@ -490,10 +497,23 @@ def cmd_chat(args) -> None:
         if remaining <= 1:
             print("(context window full)")
             break
-        _announce_run(tokens, min(_steps(args, engine), remaining),
-                      sampler=sampler)
-        engine.generate(tokens, min(_steps(args, engine), remaining), sampler,
-                        eos_id=stops, on_token=on_token)
+        budget = min(_steps(args, engine), remaining)
+        convo.extend(tokens)
+        if args.lookup_decode:
+            # greedy chat turns speculate (exact same token stream), mining
+            # drafts from the WHOLE conversation so far — prior turns are
+            # the richest n-gram source
+            res = engine.generate_lookup(tokens, budget, eos_id=stops,
+                                         draft_len=args.lookup_decode,
+                                         on_token=on_token,
+                                         vocab_size=tokenizer.vocab_size,
+                                         history=convo)
+            convo.extend(res.tokens)
+        else:
+            _announce_run(tokens, budget, sampler=sampler)
+            res = engine.generate(tokens, budget, sampler,
+                                  eos_id=stops, on_token=on_token)
+            convo.extend(res.tokens)
         print()
         if args.session:
             engine.save_session(args.session)

@@ -281,6 +281,7 @@ def write_tensor(f, x: np.ndarray, ftype: FloatType) -> None:
 def write_model(path: str, spec: ModelSpec, tensors: dict[str, np.ndarray]) -> None:
     """Write a complete `.m` file from dense f32 tensors (quantizing to the
     spec's weights_float_type where the plan demands)."""
+    spec.validate()  # reject unusable specs at write, not first read
     with open(path, "wb") as f:
         write_header(f, spec)
         for name, shape, ftype in model_tensor_plan(spec):

@@ -62,5 +62,9 @@ class ModelSpec:
     def validate(self) -> None:
         assert self.dim % self.n_heads == 0
         assert (self.dim * self.n_kv_heads) % self.n_heads == 0
+        if self.arch in (ArchType.GROK1, ArchType.MIXTRAL):
+            # MoE archs without experts would fail deep inside the forward
+            # (missing moe_router); reject at spec level instead
+            assert self.is_moe, f"{self.arch.name} requires n_experts > 0"
         if self.is_moe:
             assert 0 < self.n_active_experts <= self.n_experts

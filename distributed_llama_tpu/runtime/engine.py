@@ -629,6 +629,7 @@ class Engine:
         max_ngram: int = 3,
         on_token: Callable[[int], None] | None = None,
         vocab_size: int | None = None,
+        history: list[int] | None = None,
     ) -> GenerationResult:
         """Collecting wrapper over generate_lookup_stream (the CLI path)."""
         stats = RunStats()
@@ -637,7 +638,8 @@ class Engine:
                                              draft_len=draft_len,
                                              max_ngram=max_ngram,
                                              stats=stats,
-                                             vocab_size=vocab_size):
+                                             vocab_size=vocab_size,
+                                             history=history):
             out.append(t)
             if on_token:
                 on_token(t)

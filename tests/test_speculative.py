@@ -184,6 +184,37 @@ def test_api_lookup_decode_matches_plain(tmp_path):
     assert got_s == want_s
 
 
+def test_chat_lookup_decode_matches_plain(tmp_path, capsys, monkeypatch):
+    """Greedy chat turns with --lookup-decode produce the same transcript
+    as the plain chat loop."""
+    import builtins
+
+    from distributed_llama_tpu.apps import dllama
+    from distributed_llama_tpu.testing import write_fixture
+
+    rng = np.random.default_rng(29)
+    mpath, tpath = write_fixture(tmp_path, rng=rng, seq_len=192)
+
+    def run(extra):
+        inputs = iter(["", "abab"])
+
+        def fake_input(*a):
+            try:
+                return next(inputs)
+            except StopIteration:
+                raise EOFError
+
+        monkeypatch.setattr(builtins, "input", fake_input)
+        dllama.main(["chat", "--model", mpath, "--tokenizer", tpath,
+                     "--steps", "6", "--seed", "7", "--temperature", "0"]
+                    + extra)
+        return capsys.readouterr().out.splitlines()[-2:]
+
+    want = run([])
+    got = run(["--lookup-decode", "5"])
+    assert got == want, (got, want)
+
+
 def test_cli_lookup_decode_matches_plain(tmp_path, capsys):
     from distributed_llama_tpu.apps import dllama
     from distributed_llama_tpu.testing import write_fixture

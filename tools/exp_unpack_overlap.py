@@ -1,0 +1,124 @@
+"""Experiment: sub-tile unpack/MXU interleave for PREFILL chunks.
+
+Hypothesis: whole-model prefill sits at ~40% MFU because the fused Q40
+kernel's nibble unpack (VPU) and its MXU contraction serialize within each
+grid step (ops/pallas_q40.py docstring). Splitting the output tile into
+n_sub sub-tiles and issuing each sub-tile's dot right after its unpack
+could let the MXU queue chew on sub-tile i while the VPU unpacks i+1 —
+IF Mosaic's scheduler lets the data-independent VPU work run ahead of an
+issued matmul.
+
+STATUS: NOT YET MEASURED — the tunneled TPU backend went unavailable when
+this was queued (end of round 3). Run when a chip is free:
+
+    PYTHONPATH=/root/repo python tools/exp_unpack_overlap.py
+
+Expected decision rule: if any (td, n_sub) beats the current kernel by
+>10% at t=256, thread an n_sub parameter through pallas_q40._kernel for
+the mxu_bf16 (prefill) mode only; decode (t=1) stays VPU-bound and cannot
+benefit.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+
+from distributed_llama_tpu.ops import pallas_q40 as q  # noqa: E402
+from distributed_llama_tpu.quants.jax_codec import QuantizedTensor  # noqa: E402
+
+D, N, T = 11008, 4096, 256
+NB = N // 32
+M = 16 * NB
+
+
+def matmul_sub(x, w, n_sub, td):
+    """Like q40_matmul's bf16-MXU mode, but unpack+dot per sub-tile."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kern(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref):
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        x_lo = x_lo_ref[:].astype(jnp.bfloat16)
+        x_hi = x_hi_ref[:].astype(jnp.bfloat16)
+        xs = xsum_ref[:]
+        h = td // n_sub
+        for i in range(n_sub):
+            pk = packed_ref[i * h:(i + 1) * h, :].astype(jnp.int32)
+            lo = (pk & 0xF).astype(jnp.float32)
+            hi = (pk >> 4).astype(jnp.float32)
+            s = q._f16_bits_to_f32(
+                scales_ref[i * h:(i + 1) * h, :].astype(jnp.int32))
+            s16 = pltpu.repeat(s, 16, axis=1)
+            wl = (lo * s16).astype(jnp.bfloat16)
+            wh = (hi * s16).astype(jnp.bfloat16)
+            acc = dot(x_lo, wl)
+            acc += dot(x_hi, wh)
+            acc += dot(xs, s) * -8.0
+            out_ref[:, i * h:(i + 1) * h] = acc.astype(jnp.bfloat16)
+
+    t = x.shape[0]
+    x_lo, x_hi = q._split_activation(x.astype(jnp.float32), NB)
+    xsum = (x_lo + x_hi).reshape(t, 16, NB).sum(axis=1)
+    return pl.pallas_call(
+        kern, grid=(D // td,),
+        in_specs=[
+            pl.BlockSpec((t, M), lambda i: (0, 0)),
+            pl.BlockSpec((t, M), lambda i: (0, 0)),
+            pl.BlockSpec((t, NB), lambda i: (0, 0)),
+            pl.BlockSpec((td, M), lambda i: (i, 0)),
+            pl.BlockSpec((td, NB), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, td), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((t, D), jnp.bfloat16),
+        cost_estimate=pl.CostEstimate(flops=2 * t * D * N,
+                                      bytes_accessed=D * M,
+                                      transcendentals=0),
+    )(x_lo, x_hi, xsum, w.packed, w.scales)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    packed = jnp.asarray(rng.integers(0, 256, (D, M), dtype=np.uint8))
+    scales = jnp.asarray((rng.random((D, NB), dtype=np.float32) * 0.004
+                          ).astype(np.float16).view(np.uint16))
+    w = QuantizedTensor(packed, scales)
+    x = jnp.asarray(rng.standard_normal((T, N), dtype=np.float32))
+
+    def chain(fn):
+        @jax.jit
+        def run(x):
+            y = x
+            for _ in range(8):
+                o = fn(y)
+                y = (o[:, :N] * 1e-3).astype(jnp.float32)
+            return y
+        return run
+
+    fl = 2 * T * D * N
+    variants = [("current", lambda v: q.q40_matmul(v, w, out_dtype=jnp.bfloat16))]
+    variants += [(f"td={td} n_sub={ns}",
+                  lambda v, td=td, ns=ns: matmul_sub(v, w, ns, td))
+                 for td, ns in ((512, 2), (512, 4))]
+    for name, fn in variants:
+        run = chain(fn)
+        np.asarray(run(x))  # compile
+        t0 = time.perf_counter()
+        np.asarray(run(x))
+        dt = (time.perf_counter() - t0) / 8
+        print(f"{name}: {dt*1e3:.3f} ms/call, {fl/dt/1e12:.1f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()
