@@ -163,17 +163,27 @@ def build_engine(args):
     if multihost:
         # every process must agree on the mesh/dtype flags (the reference
         # memcpys its spec struct over the socket and hopes — we verify).
-        # The MODEL SPEC and TOKENIZER are fingerprinted too: hosts loading
+        # The MODEL and TOKENIZER files are fingerprinted too: hosts loading
         # different .m/.t files would desync eos step counts and hang the
-        # cluster in a mismatched collective instead of erroring (ADVICE r2)
+        # cluster in a mismatched collective instead of erroring (ADVICE r2).
+        # The model hash samples file size + start/middle/end chunks, so
+        # same-architecture different-weight builds (fine-tunes, requants)
+        # are caught without reading a 40 GB file
         import dataclasses
+        import os
         import zlib
 
         from ..parallel.multihost import check_config
         spec_fp = zlib.crc32(repr(dataclasses.astuple(spec)).encode())
+        size = os.path.getsize(args.model)
+        model_fp = zlib.crc32(str(size).encode())
+        with open(args.model, "rb") as f:
+            for off in (0, size // 2, max(size - 65536, 0)):
+                f.seek(off)
+                model_fp = zlib.crc32(f.read(65536), model_fp)
         with open(args.tokenizer, "rb") as f:
             tok_fp = zlib.crc32(f.read())
-        check_config([spec_fp, tok_fp,
+        check_config([spec_fp, model_fp, tok_fp,
                       args.tp, args.dp, args.sp, args.ep, args.pp,
                       int(args.buffer_float_type == "q80"),
                       int(args.compute_dtype == "bf16"),
@@ -454,6 +464,11 @@ def cmd_chat(args) -> None:
         # worse than an error
         sys.exit("error: --lookup-decode is exact for greedy decoding only "
                  "(pass --temperature 0) and does not compose with --nnodes")
+    if args.session and (args.nnodes > 1 or args.pp > 1):
+        # save_session fetches the cache to the host — impossible for a
+        # multi-process mesh (non-addressable shards) and unsupported for
+        # stage-stacked pp caches; fail before the first turn, not after it
+        sys.exit("error: --session does not compose with --nnodes or --pp")
     engine, tokenizer, sampler = build_engine(args)
     convo: list[int] = []  # whole-conversation tokens: the draft miner's
     # n-gram source (chat history is full of quotable n-grams)

@@ -558,6 +558,13 @@ class Engine:
 
         from .speculative import count_accepted, find_draft
 
+        if max_tokens <= 0:
+            # budget-0 parity with the plain loop: prefill advances the
+            # cache, nothing is emitted
+            self.prefill(prompt)
+            self.last_accept_stats = (1, 0)
+            return
+
         t0 = time.perf_counter()
         logits = self.prefill(prompt)
         logits_np = self.fetch_logits(logits)

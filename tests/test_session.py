@@ -117,6 +117,20 @@ def test_session_restores_onto_mesh(tmp_path):
     assert got == want, (got, want)
 
 
+def test_chat_session_rejects_multihost_and_pp(tmp_path):
+    """--session save fetches the cache to the host, which cannot work for
+    multi-process meshes or stage-stacked pp caches — chat must refuse the
+    combination up front instead of crashing after the first turn."""
+    from distributed_llama_tpu.apps import dllama
+    from distributed_llama_tpu.testing import write_fixture
+
+    rng = np.random.default_rng(23)
+    mpath, tpath = write_fixture(tmp_path, rng=rng, seq_len=192)
+    with pytest.raises(SystemExit, match="session"):
+        dllama.main(["chat", "--model", mpath, "--tokenizer", tpath,
+                     "--pp", "2", "--session", str(tmp_path / "s.npz")])
+
+
 def test_chat_session_flag_resumes(tmp_path, capsys, monkeypatch):
     """CLI: `chat --session FILE` saves after each turn and resumes —
     the resumed process continues from the cached positions."""

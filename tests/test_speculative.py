@@ -34,6 +34,41 @@ def test_find_draft_prefers_longest_ngram():
     assert find_draft(h2, 1, max_ngram=2) == [8]
 
 
+def test_find_draft_property_fuzz():
+    """For random histories: any returned draft must be the exact
+    continuation of the LAST earlier occurrence of some trailing n-gram
+    (n <= max_ngram), and longer n-grams must win over shorter ones."""
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        n = int(rng.integers(2, 40))
+        h = rng.integers(0, 6, n).astype(np.int32)  # small alphabet: matches
+        d = find_draft(h, 4, max_ngram=3)
+        if not d:
+            # no trailing 1..3-gram may occur earlier
+            for k in (3, 2, 1):
+                if n < k + 1:
+                    continue
+                pat = h[-k:]
+                win = np.lib.stride_tricks.sliding_window_view(h, k)
+                hits = np.nonzero((win == pat).all(axis=1))[0]
+                assert not (hits < n - k).any(), (h, k)
+            continue
+        ok = False
+        for k in (3, 2, 1):  # longest match wins
+            if n < k + 1:
+                continue
+            pat = h[-k:]
+            win = np.lib.stride_tricks.sliding_window_view(h, k)
+            hits = np.nonzero((win == pat).all(axis=1))[0]
+            hits = hits[hits < n - k]
+            if hits.size:
+                j = int(hits[-1]) + k
+                assert d == h[j: j + 4].tolist(), (h, k, d)
+                ok = True
+                break
+        assert ok, (h, d)
+
+
 def test_count_accepted():
     assert count_accepted([4, 5, 6], np.asarray([4, 5, 9, 0])) == 2
     assert count_accepted([4], np.asarray([7, 1])) == 0
@@ -123,6 +158,18 @@ def test_lookup_matches_greedy_on_kernel_path():
                  pallas_interpret=True)
     got = eng.generate_lookup(prompt, 12, draft_len=4)
     assert got.tokens == want, (got.tokens, want)
+
+
+def test_lookup_budget_zero_emits_nothing():
+    """max_tokens == 0 must emit nothing (prefill still advances the cache)
+    — the plain loop's behavior at the context boundary."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=64)
+    host, _ = dense_weights(spec, seed=41)
+    eng = _engine(spec, host)
+    out = eng.generate_lookup([1, 5, 9], 0)
+    assert out.tokens == []
+    assert eng.pos == 3 and eng.last_accept_stats == (1, 0)
 
 
 def test_lookup_eos_truncates_and_continues():
