@@ -559,8 +559,11 @@ class Engine:
         from .speculative import count_accepted, find_draft
 
         if max_tokens <= 0:
-            # budget-0 parity with the plain loop: prefill advances the
-            # cache, nothing is emitted
+            # budget-0 emits nothing (prefill still advances the cache) —
+            # matching the API server's plain token iterator at n_gen == 0.
+            # NOTE: Engine.generate() emits its first sampled token BEFORE
+            # checking the budget, so it returns 1 token at max_tokens=0;
+            # the iterator semantics here treat the budget as a hard cap
             self.prefill(prompt)
             self.last_accept_stats = (1, 0)
             return

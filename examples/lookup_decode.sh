@@ -1,0 +1,23 @@
+#!/bin/sh
+# Prompt-lookup speculative decoding demo: the same greedy request run
+# plain and with --lookup-decode must print the same text, while the
+# speculative run reports its tokens/forward acceptance (net-new — the
+# reference generates strictly one token per forward).
+# Uses the test fixture model; swap --model/--tokenizer for a real one.
+set -e
+cd "$(dirname "$0")/.."
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+python - "$TMP" <<'EOF'
+import pathlib, sys
+from distributed_llama_tpu.testing import write_fixture
+write_fixture(pathlib.Path(sys.argv[1]), seed=5, seq_len=192)
+EOF
+echo "=== plain greedy ==="
+python -m distributed_llama_tpu.apps.dllama inference \
+    --model "$TMP/model.m" --tokenizer "$TMP/tok.t" \
+    --prompt "abab" --steps 12 --temperature 0 --seed 7
+echo "=== speculative (--lookup-decode 5) ==="
+python -m distributed_llama_tpu.apps.dllama inference \
+    --model "$TMP/model.m" --tokenizer "$TMP/tok.t" \
+    --prompt "abab" --steps 12 --temperature 0 --seed 7 --lookup-decode 5
