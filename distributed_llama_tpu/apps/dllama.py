@@ -474,7 +474,7 @@ def cmd_chat(args) -> None:
     # n-gram source (chat history is full of quotable n-grams)
     resumed = False
     if args.session and os.path.exists(args.session):
-        engine.load_session(args.session)
+        convo = engine.load_session(args.session)
         resumed = True
         print(f"💾 resumed session from {args.session} "
               f"({engine.pos} cached positions)")
@@ -531,7 +531,9 @@ def cmd_chat(args) -> None:
             convo.extend(res.tokens)
         print()
         if args.session:
-            engine.save_session(args.session)
+            # token history rides along so a resumed process keeps mining
+            # speculative drafts from pre-restart turns
+            engine.save_session(args.session, tokens=convo)
 
 
 def cmd_worker(args) -> None:

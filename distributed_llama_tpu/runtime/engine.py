@@ -243,18 +243,24 @@ class Engine:
 
     # -- session persistence ----------------------------------------------
 
-    def save_session(self, path: str) -> None:
+    def save_session(self, path: str, tokens: list[int] | None = None) -> None:
         """Persist the generation session — pos and the FILLED cache prefix
         (positions < pos) — to an .npz. Net-new vs the reference, which has
         no KV-cache persistence or session resume (SURVEY.md §5.4): a chat
         can continue across process restarts without re-prefilling its
         history. Narrow dtypes (bf16/fp8) are stored as raw bit patterns
-        (numpy's format cannot describe them)."""
+        (numpy's format cannot describe them).
+
+        tokens: optional token history to carry alongside the cache (the
+        chat CLI stores its conversation so a resumed session can keep
+        mining speculative drafts from pre-restart turns)."""
         assert self._pp == 1, "session save/restore does not support --pp"
         data: dict = {
             "pos": np.int64(self.pos),
             "cache_dtype": np.str_(jnp.dtype(self.cache_dtype).name),
             "config": np.asarray(self._session_fingerprint(), np.int64),
+            "tokens": np.asarray(tokens if tokens is not None else [],
+                                 np.int32),
         }
         for l in range(self.spec.n_layers):
             for name, leaf in (("k", self.cache.k[l]), ("v", self.cache.v[l])):
@@ -269,10 +275,11 @@ class Engine:
         with open(path, "wb") as f:
             np.savez(f, **data)
 
-    def load_session(self, path: str) -> None:
+    def load_session(self, path: str) -> list[int]:
         """Restore a save_session() file: refuses a mismatched model/engine
         config, rebuilds the cache with the saved prefix in place (sharded
-        placement included) and sets pos."""
+        placement included) and sets pos. Returns the saved token history
+        ([] for files saved without one)."""
         assert self._pp == 1, "session save/restore does not support --pp"
         z = np.load(path)
         if list(z["config"]) != self._session_fingerprint():
@@ -301,6 +308,7 @@ class Engine:
                 v_all.append(jnp.asarray(host["v"]))
         self.cache = KVCache(tuple(k_all), tuple(v_all))
         self.pos = pos
+        return z["tokens"].tolist() if "tokens" in z.files else []
 
     def _session_fingerprint(self) -> list[int]:
         import zlib
