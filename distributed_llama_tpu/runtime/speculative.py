@@ -27,9 +27,17 @@ def find_draft(
     max_ngram: int = 3,
     min_ngram: int = 1,
 ) -> list[int]:
-    """Longest-suffix n-gram match: for n = max_ngram..min_ngram, find the
-    LAST earlier occurrence of the trailing n tokens and return up to
-    draft_len tokens that followed it. [] when nothing matches."""
+    """Longest-suffix n-gram match: for n = max_ngram..min_ngram, find an
+    earlier occurrence of the trailing n tokens and return up to draft_len
+    tokens that followed it. [] when nothing matches.
+
+    Among the occurrences of the winning n-gram, the MOST RECENT one that
+    still has a full draft_len continuation wins (recency bias), falling
+    back to the most recent occurrence outright. Looping text — exactly
+    where lookup decoding pays — otherwise keeps matching a position a
+    token or two from the end of history, yielding truncated length-1
+    drafts and ~1 token/forward where a slightly older match drafts the
+    whole cycle."""
     h = np.asarray(history)
     ln = h.shape[0]
     for n in range(max_ngram, min_ngram - 1, -1):
@@ -40,7 +48,8 @@ def find_draft(
         hits = np.nonzero((win == pat).all(axis=1))[0]
         hits = hits[hits < ln - n]  # exclude the suffix itself
         if hits.size:
-            j = int(hits[-1]) + n
+            full = hits[hits + n + draft_len <= ln]
+            j = int(full[-1] if full.size else hits[-1]) + n
             return h[j: j + draft_len].tolist()
     return []
 

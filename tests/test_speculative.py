@@ -34,10 +34,22 @@ def test_find_draft_prefers_longest_ngram():
     assert find_draft(h2, 1, max_ngram=2) == [8]
 
 
+def test_find_draft_full_continuation_preference():
+    # trailing (1,2) occurs at 0 (full 3-token continuation) and at 5
+    # (only 2 tokens left): the older, full match must win at draft_len=3
+    h = np.asarray([1, 2, 9, 8, 7, 1, 2, 1, 2], np.int32)
+    assert find_draft(h, 3, max_ngram=2) == [9, 8, 7]
+    # when the recent match satisfies the budget, recency wins
+    assert find_draft(h, 2, max_ngram=2) == [1, 2]
+
+
 def test_find_draft_property_fuzz():
-    """For random histories: any returned draft must be the exact
-    continuation of the LAST earlier occurrence of some trailing n-gram
-    (n <= max_ngram), and longer n-grams must win over shorter ones."""
+    """For random histories, assert the draft's properties WITHOUT
+    re-implementing the selection rule: the draft must be the exact
+    continuation of SOME earlier occurrence of the winning (longest
+    matching) trailing n-gram, and whenever any earlier occurrence has a
+    full draft_len continuation available, the draft must be full
+    length (the anti-truncation guarantee)."""
     rng = np.random.default_rng(3)
     for _ in range(200):
         n = int(rng.integers(2, 40))
@@ -62,8 +74,12 @@ def test_find_draft_property_fuzz():
             hits = np.nonzero((win == pat).all(axis=1))[0]
             hits = hits[hits < n - k]
             if hits.size:
-                j = int(hits[-1]) + k
-                assert d == h[j: j + 4].tolist(), (h, k, d)
+                # the draft is SOME hit's exact continuation...
+                assert any(d == h[j + k: j + k + 4].tolist()
+                           for j in hits), (h, k, d)
+                # ...and is full-length whenever any hit could supply one
+                if (hits + k + 4 <= n).any():
+                    assert len(d) == 4, (h, k, d)
                 ok = True
                 break
         assert ok, (h, d)
