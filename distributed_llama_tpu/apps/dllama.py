@@ -106,7 +106,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "lax.while_loop that exits at eos; temperature/"
                         "top-p + reference-parity xorshift on the TPU — no "
                         "host round-trip per token). Composes with --dp: "
-                        "each batch row gets its own device RNG stream. "
+                        "batch row i gets its own device RNG stream seeded "
+                        "seed+i (same prompt, distinct samples). "
                         "Output streams after the loop. Net-new: the "
                         "reference samples on CPU every token")
     p.add_argument("--lookup-decode", type=int, default=0, metavar="K",
@@ -135,7 +136,7 @@ def build_engine(args):
     (ref: src/app.cpp:103-132)."""
     import jax.numpy as jnp
 
-    from ..io.model_file import read_spec
+    from ..io.model_file import content_fingerprint, read_spec
     from ..models.loader import load_params_streamed
     from ..quants.types import FloatType
     from ..runtime.engine import Engine
@@ -159,6 +160,10 @@ def build_engine(args):
     kdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
            "f8": jnp.float8_e4m3fn}[args.cache_dtype]
 
+    # sampled content hash of the weights file — folded into the KV-session
+    # fingerprint always, and into the cluster config check when multihost
+    model_fp = content_fingerprint(args.model)
+
     multihost = jax.process_count() > 1
     if multihost:
         # every process must agree on the mesh/dtype flags (the reference
@@ -170,17 +175,10 @@ def build_engine(args):
         # same-architecture different-weight builds (fine-tunes, requants)
         # are caught without reading a 40 GB file
         import dataclasses
-        import os
         import zlib
 
         from ..parallel.multihost import check_config
         spec_fp = zlib.crc32(repr(dataclasses.astuple(spec)).encode())
-        size = os.path.getsize(args.model)
-        model_fp = zlib.crc32(str(size).encode())
-        with open(args.model, "rb") as f:
-            for off in (0, size // 2, max(size - 65536, 0)):
-                f.seek(off)
-                model_fp = zlib.crc32(f.read(65536), model_fp)
         with open(args.tokenizer, "rb") as f:
             tok_fp = zlib.crc32(f.read())
         check_config([spec_fp, model_fp, tok_fp,
@@ -231,6 +229,9 @@ def build_engine(args):
         activation_q80=(q80 and mode == "q40"),
         q80_collectives=q80,
         use_pallas=args.pallas,  # None -> engine default (on for TPU)
+        # folded into the KV-session fingerprint: a session saved from a
+        # same-shape different-weight model must be refused (ADVICE r3)
+        model_fingerprint=model_fp,
     )
 
     tokenizer = Tokenizer.from_file(args.tokenizer)
@@ -421,16 +422,20 @@ def _print_benchmark(args, engine, res, trace_dir=None) -> None:
     if trace_dir:
         from ..runtime.netstats import per_step_op_ms
 
-        mod_t = per_step_op_ms(trace_dir, module_hint="run")
-        if mod_t and len(res.stats.steps) > 1:
-            # module executions = prefill chunks + decode steps; align
-            # decode steps from the tail, fold the prefill chunks into
-            # the first stats row
-            n_dec = min(len(res.stats.steps) - 1, len(mod_t))
-            tail = mod_t[len(mod_t) - n_dec:]
-            t_steps = [sum(mod_t[: len(mod_t) - n_dec])] + tail
-        elif mod_t:
-            t_steps = [sum(mod_t)]
+        # the engine names its jitted wrappers by role (decode_step /
+        # prefill_chunk_N / prefill_seg — engine._compiled_step), so decode
+        # executions are matched exactly instead of tail-aligning every
+        # module named 'run' (ADVICE r3: extra executions in the window
+        # shifted T onto the wrong steps). A count mismatch means the
+        # window caught unrelated executions — fall back to the microbench.
+        dec_t = per_step_op_ms(trace_dir, module_hint="decode_step")
+        pre_t = per_step_op_ms(trace_dir, module_hint="prefill")
+        n_dec = len(res.stats.steps) - 1
+        if len(dec_t) == n_dec and (dec_t or pre_t):
+            t_steps = [sum(pre_t)] + dec_t  # n_dec == 0: prefill-only run
+        elif dec_t or pre_t:
+            print(f"⏩ trace module count mismatch (decode {len(dec_t)} vs "
+                  f"{n_dec} steps); using the microbench T estimate")
     for i, s in enumerate(res.stats.steps):
         tv = t_steps[i] if i < len(t_steps) else t_ms
         print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "

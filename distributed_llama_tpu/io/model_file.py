@@ -278,6 +278,25 @@ def write_tensor(f, x: np.ndarray, ftype: FloatType) -> None:
         raise ValueError(ftype)
 
 
+def content_fingerprint(path: str) -> int:
+    """Cheap content hash of a model file: CRC of the size plus 64 KiB
+    sampled at the start, middle and end — catches same-architecture
+    different-weight builds (fine-tunes, requants) without reading a
+    40 GB file. Used by the multihost cluster config check and the
+    KV-session fingerprint (both would otherwise pair a cache/cluster
+    with weights that never produced it)."""
+    import os
+    import zlib
+
+    size = os.path.getsize(path)
+    fp = zlib.crc32(str(size).encode())
+    with open(path, "rb") as f:
+        for off in (0, size // 2, max(size - 65536, 0)):
+            f.seek(off)
+            fp = zlib.crc32(f.read(65536), fp)
+    return fp
+
+
 def write_model(path: str, spec: ModelSpec, tensors: dict[str, np.ndarray]) -> None:
     """Write a complete `.m` file from dense f32 tensors (quantizing to the
     spec's weights_float_type where the plan demands)."""

@@ -58,9 +58,13 @@ class Engine:
         # and measured slower)
         use_pallas: bool | None = None,
         pallas_interpret: bool = False,
+        model_fingerprint: int = 0,  # content hash of the weights the
+        # session fingerprint folds in (io.model_file.content_fingerprint);
+        # 0 = unknown (in-memory params) — such sessions only check shapes
     ):
         self.mesh = mesh
         self.batch = batch
+        self.model_fingerprint = int(model_fingerprint)
         self.seq_len = min(max_seq_len or spec.seq_len, spec.seq_len)
         self.compute_dtype = compute_dtype
         self.cache_dtype = cache_dtype
@@ -282,11 +286,16 @@ class Engine:
         ([] for files saved without one)."""
         assert self._pp == 1, "session save/restore does not support --pp"
         z = np.load(path)
-        if list(z["config"]) != self._session_fingerprint():
+        saved, mine = list(z["config"]), self._session_fingerprint()
+        # the weight-content element compares only when BOTH sides know it:
+        # 0 means in-memory params (and 4-element files predate the field)
+        # — those degrade to the shape-only check
+        content_ok = (len(saved) < 5 or saved[4] == mine[4]
+                      or 0 in (saved[4], mine[4]))
+        if saved[:4] != mine[:4] or not content_ok:
             raise ValueError(
                 "session file does not match this engine's model/config "
-                f"(saved {list(z['config'])}, "
-                f"engine {self._session_fingerprint()})")
+                f"(saved {saved}, engine {mine})")
         pos = int(z["pos"])
         assert pos <= self.seq_len
         self.reset()
@@ -311,6 +320,13 @@ class Engine:
         return z["tokens"].tolist() if "tokens" in z.files else []
 
     def _session_fingerprint(self) -> list[int]:
+        # architecture dims + cache shape/dtype + the WEIGHT CONTENT hash:
+        # a session saved from a same-shape different-weight model (a
+        # fine-tune, a requant) would otherwise resume against a KV cache
+        # the loaded weights never produced — garbage continuations with
+        # no error (ADVICE r3; the multihost cluster fingerprint guards
+        # the same hazard). model_fingerprint == 0 (in-memory params)
+        # degrades to the shape-only check.
         import zlib
 
         sp = self.spec
@@ -318,7 +334,8 @@ class Engine:
                                  sp.n_heads, sp.n_kv_heads,
                                  sp.head_size)).encode()),
                 self.batch, self.seq_len,
-                zlib.crc32(jnp.dtype(self.cache_dtype).name.encode())]
+                zlib.crc32(jnp.dtype(self.cache_dtype).name.encode()),
+                self.model_fingerprint]
 
     # -- observability -----------------------------------------------------
 
@@ -405,6 +422,18 @@ class Engine:
                 return forward(params, self.spec, tokens, pos0, cache,
                                logits_for_all=logits_for_all, **common)
 
+        # role-specific wrapper names so profiler traces can attribute XLA
+        # module executions: with every wrapper named 'run', per-step T
+        # alignment mis-attributed whenever extra modules ran inside the
+        # trace window (ADVICE r3). decode_step is uniquely the 1-token
+        # host-loop step the benchmark hints on.
+        run.__name__ = (
+            "prefill_seg" if with_logit_index
+            else "decode_step" if key == 1
+            else f"prefill_chunk_{key}" if isinstance(key, int)
+            else f"prefill_chunk_{key[1]}" if key[0] == "prefill"
+            else "verify_step" if key[0] == "lookup"
+            else "batch_decode_step")
         fn = jax.jit(run, donate_argnums=(3,))
         self._steps[key] = fn
         return fn
@@ -412,16 +441,23 @@ class Engine:
     def _step_fn(self, t: int) -> Callable:
         return self._compiled_step(t)
 
-    def step(self, tokens: np.ndarray, pos0: int) -> jax.Array:
+    def step(self, tokens: np.ndarray, pos0: int, *,
+             _key=None) -> jax.Array:
         """Run a (B, T) segment from absolute position pos0; returns last-token
-        logits (B, vocab) on device. Advances cache/pos."""
+        logits (B, vocab) on device. Advances cache/pos.
+
+        _key overrides the compile-cache key — prefill() routes a width-1
+        trailing chunk through ("prefill", 1) so its trace module is named
+        prefill_chunk_1, not decode_step (the benchmark counts decode
+        executions exactly)."""
         b, t = tokens.shape
         assert b == self.batch
         assert pos0 + t <= self.seq_len, "context overflow"
         tok = jnp.asarray(tokens, jnp.int32)
         if self._token_sharding is not None:
             tok = jax.device_put(tok, self._token_sharding)
-        logits, self.cache = self._step_fn(t)(
+        logits, self.cache = self._compiled_step(_key if _key is not None
+                                                 else t)(
             self.params, tok, jnp.int32(pos0), self.cache)
         self.pos = pos0 + t
         return logits
@@ -458,7 +494,8 @@ class Engine:
         while i < n:
             chunk = min(self.prefill_chunk, n - i)
             seg = np.asarray(prompt[i:i + chunk], np.int32)[None, :]
-            logits = self.step(seg, self.pos)
+            logits = self.step(seg, self.pos,
+                               _key=("prefill", 1) if chunk == 1 else None)
             i += chunk
         return logits
 
@@ -595,13 +632,18 @@ class Engine:
                and token not in stop_ids):
             # draft sized to the remaining budget/context (the +1 below is
             # the fed token itself; its K/V write needs a free slot)
+            g0 = time.perf_counter()
             k = min(draft_len, self.seq_len - self.pos - 1,
                     max_tokens - n_out - 1)
             draft = find_draft(hist, k, max_ngram=max_ngram) if k > 0 else []
             seg = np.asarray([[token] + draft], np.int32)
             pos0 = self.pos
 
-            g0 = time.perf_counter()
+            # device_ms covers only the verify forward + the logits D2H
+            # (like generate()'s step timing); draft mining and the host
+            # argmax are host_ms — benchmark 'Avg inference time' would
+            # otherwise overstate device time for lookup runs (ADVICE r3)
+            d0 = time.perf_counter()
             fn = self._compiled_step(("lookup", seg.shape[1]),
                                      logits_for_all=True)
             tok_dev = jnp.asarray(seg)
@@ -609,12 +651,14 @@ class Engine:
                 tok_dev = jax.device_put(tok_dev, self._token_sharding)
             logits, self.cache = fn(
                 self.params, tok_dev, jnp.int32(pos0), self.cache)
-            greedy = np.argmax(self.fetch_logits(logits)[0][:, :spec_v],
-                               axis=-1)
+            logits_np = self.fetch_logits(logits)
+            d1 = time.perf_counter()
+            greedy = np.argmax(logits_np[0][:, :spec_v], axis=-1)
             g1 = time.perf_counter()
             if stats is not None:
                 stats.add(StepStats(generation_ms=(g1 - g0) * 1e3,
-                                    device_ms=(g1 - g0) * 1e3))
+                                    device_ms=(d1 - d0) * 1e3,
+                                    host_ms=(g1 - g0 - (d1 - d0)) * 1e3))
 
             m = count_accepted(draft, greedy)
             emitted = [int(g) for g in greedy[: m + 1]]
@@ -855,10 +899,12 @@ class Engine:
         vocab_size: int | None = None,
     ) -> list[list[int]]:
         """Batched sampled generation with the whole decode loop on device:
-        `batch` independent sequences, each with its OWN xorshift* stream
-        seeded from `seed` — so row i's tokens match a single-sequence
-        generate_device run of that prompt with the same seed (greedy AND
-        sampled; the host generate_batch instead interleaves one shared
+        `batch` independent sequences, each with its OWN xorshift* stream —
+        row i is seeded `seed + i`, so its tokens match a single-sequence
+        generate_device run of that prompt with seed + i (greedy AND
+        sampled; distinct per-row streams mean dp rows serving the SAME
+        prompt still sample distinct continuations at temperature > 0,
+        while the host generate_batch instead interleaves one shared
         sampler stream across rows). Composes with dp meshes: the batch and
         every per-row carry shard over dp. Removes generate_batch's
         per-row host sampling loop (the reference has no batching at all —
@@ -943,7 +989,7 @@ class Engine:
             self._steps[key] = run
 
         posv = jnp.asarray(lens)
-        rng0 = jnp.broadcast_to(state_from_seed(seed)[None], (b, 2))
+        rng0 = jnp.stack([state_from_seed(seed + i) for i in range(b)])
         if self._token_sharding is not None:
             posv = jax.device_put(posv,
                                   NamedSharding(self.mesh, P(DP_AXIS)))

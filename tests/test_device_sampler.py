@@ -164,8 +164,8 @@ def test_generate_device_early_exit_step_count():
 @pytest.mark.parametrize("use_mesh", [False, True])
 def test_generate_batch_device_matches_independent_runs(use_mesh):
     """Batched on-device sampling (VERDICT #5): dp=4 sampled generation
-    matches 4 independent generate_device runs per-row — each row owns a
-    device xorshift stream seeded identically."""
+    matches 4 independent generate_device runs per-row — row i owns a
+    device xorshift stream seeded seed + i."""
     from jax.sharding import Mesh
 
     spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
@@ -181,14 +181,27 @@ def test_generate_batch_device_matches_independent_runs(use_mesh):
 
     for temp, topp, seed in ((0.0, 0.9, 3), (0.7, 0.9, 5)):
         want = []
-        for p in prompts:
+        for i, p in enumerate(prompts):
             eng1 = _engine(spec, host_w)
             want.append(eng1.generate_device(p, 8, temperature=temp,
-                                             topp=topp, seed=seed))
+                                             topp=topp, seed=seed + i))
         engb = _engine(spec, host_w, batch=4, **kw)
         got = engb.generate_batch_device(prompts, 8, temperature=temp,
                                          topp=topp, seed=seed)
         assert got == want, (temp, topp)
+
+
+def test_generate_batch_device_same_prompt_distinct_samples():
+    """The dp serving case the per-row streams exist for: identical
+    prompts at temperature > 0 must NOT produce identical rows (one
+    broadcast RNG state would duplicate every continuation)."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=64)
+    host_w, _ = dense_weights(spec, seed=31)
+    eng = _engine(spec, host_w, batch=4)
+    outs = eng.generate_batch_device([[1, 5, 9]] * 4, 12, temperature=0.9,
+                                     topp=0.9, seed=11)
+    assert len({tuple(o) for o in outs}) > 1, outs
 
 
 def test_generate_batch_device_eos_per_row():
@@ -208,12 +221,9 @@ def test_generate_batch_device_eos_per_row():
     got = eng.generate_batch_device(prompts, 20, temperature=0.0, topp=0.9,
                                     seed=1, eos_id=eos)
     want = []
-    for row in [
-        _engine(spec, host_w).generate_device(p, 20, temperature=0.0,
-                                              topp=0.9, seed=1, eos_id=eos)
-        for p in prompts
-    ]:
-        want.append(row)
+    for i, p in enumerate(prompts):
+        want.append(_engine(spec, host_w).generate_device(
+            p, 20, temperature=0.0, topp=0.9, seed=1 + i, eos_id=eos))
     assert got == want
     # the loop must exit early once both rows are done, not run 20 steps
     assert eng.last_device_steps <= max(len(r) for r in got) + 1

@@ -118,6 +118,45 @@ def test_session_rejects_mismatched_config(tmp_path):
         f8.load_session(str(tmp_path / "s.npz"))
 
 
+def test_session_rejects_different_weight_content(tmp_path):
+    """A same-shape model with different weights (fine-tune, requant) must
+    be refused: its KV cache never came from the loaded weights (ADVICE
+    r3). build_engine passes the model file's content fingerprint; two
+    different files yield different fingerprints."""
+    spec, host = _spec_host()
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    eng = Engine(spec, params, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, model_fingerprint=0xAAAA)
+    eng.generate([1, 5], 2, greedy())
+    eng.save_session(str(tmp_path / "s.npz"))
+
+    tuned = Engine(spec, params, compute_dtype=jnp.float32,
+                   cache_dtype=jnp.float32, model_fingerprint=0xBBBB)
+    with pytest.raises(ValueError, match="does not match"):
+        tuned.load_session(str(tmp_path / "s.npz"))
+
+    same = Engine(spec, params, compute_dtype=jnp.float32,
+                  cache_dtype=jnp.float32, model_fingerprint=0xAAAA)
+    assert same.load_session(str(tmp_path / "s.npz")) == []
+    assert same.pos == eng.pos
+
+    # fingerprint 0 = unknown weights (in-memory params): degrades to the
+    # shape-only check instead of refusing every CLI-saved session
+    unknown = Engine(spec, params, compute_dtype=jnp.float32,
+                     cache_dtype=jnp.float32)
+    assert unknown.load_session(str(tmp_path / "s.npz")) == []
+
+
+def test_content_fingerprint_distinguishes_files(tmp_path):
+    from distributed_llama_tpu.io.model_file import content_fingerprint
+
+    a, b = tmp_path / "a.bin", tmp_path / "b.bin"
+    a.write_bytes(b"\x01" * 100_000)
+    b.write_bytes(b"\x01" * 99_999 + b"\x02")  # same size, one byte off
+    assert content_fingerprint(str(a)) != content_fingerprint(str(b))
+    assert content_fingerprint(str(a)) == content_fingerprint(str(a))
+
+
 def test_session_restores_onto_mesh(tmp_path):
     """A session saved on a single device restores onto a tp mesh (the
     cache re-places with the engine's sharding) and continues exactly."""
