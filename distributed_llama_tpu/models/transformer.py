@@ -391,6 +391,7 @@ def forward(
     pallas_interpret: bool = False,
     sp_cache_mesh=None,
     pp_mesh=None,
+    pp_gpipe: bool = True,
     logit_index=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
@@ -427,11 +428,23 @@ def forward(
         q_pos = jnp.broadcast_to(q_pos, (b, t))
 
     if pp_mesh is not None:
-        # layers placed in stages over pp (parallel/pp.py)
-        from ..parallel.pp import pp_layers
+        # layers placed in stages over pp (parallel/pp.py): long segments
+        # (prefill chunks) take the GPipe sequence-microbatch schedule —
+        # flop-bound, wall ~ 1/pp of the all-stages scheme; decode/verify
+        # segments (weight-read-bound) keep all-stages
+        from ..parallel.pp import (gpipe_microbatches, pp_layers,
+                                   pp_layers_gpipe)
 
-        x, k_all, v_all = pp_layers(x, params["layers"], spec, cache, q_pos,
-                                    cfg, pp_mesh, per_row_pos=per_row_pos)
+        n_mb = (gpipe_microbatches(t, pp_mesh.shape["pp"])
+                if pp_gpipe else 1)
+        if n_mb > 1:
+            x, k_all, v_all = pp_layers_gpipe(
+                x, params["layers"], spec, cache, q_pos, cfg, pp_mesh,
+                n_mb, per_row_pos=per_row_pos)
+        else:
+            x, k_all, v_all = pp_layers(x, params["layers"], spec, cache,
+                                        q_pos, cfg, pp_mesh,
+                                        per_row_pos=per_row_pos)
         k_all, v_all = list(k_all), list(v_all)
     else:
         # statically unrolled layer loop (see module docstring for why not

@@ -45,9 +45,23 @@ the sequential layer loop, which is the floor for a single in-flight
 token. A GPipe microbatch rotation (b/pp rows per stage-step, 2pp-1
 steps) would re-read the same weights (2pp-1)/pp times per token — ~2x
 SLOWER for decode. The off-stage compute it "burns" costs energy, not
-time: those devices would otherwise idle. GPipe-style overlap pays off
-only for flop-bound work (long prefill chunks at high batch) — a
-possible follow-up for the prefill path specifically.
+time: those devices would otherwise idle.
+
+PREFILL is the opposite regime (flop-bound: T tokens amortize every
+weight read), and there the all-stages scheme throws away the pp axis —
+wall equals ONE device running all layers. `pp_layers_gpipe` recovers it
+(VERDICT r3 weak #4): the T-token segment splits into M sequence-
+microbatches that rotate through the stages GPipe-style — step t runs
+microbatch t-s on stage s, activations hop stage s -> s+1 via ppermute,
+and each device computes ONLY its own layers. Wall drops from T·L·c to
+(M+pp-1)/M · T·L·c/pp (M=8, pp=2: 1.78x; -> pp x as M grows). Sequence-
+microbatching keeps causality free: microbatch m reaches stage s after
+m-1 already wrote that stage's KV slots, so attention reads are ready by
+construction. Cache writes gate on schedule validity (bubble steps
+re-write existing values); only the last stage's outputs survive into
+the (single, final) psum. forward() picks the schedule per segment:
+gpipe_microbatches() returns M > 1 only for long segments (>= 32 tokens
+per stage), so decode and speculative verify stay all-stages.
 """
 
 from __future__ import annotations
@@ -176,6 +190,40 @@ def _leaf_in_spec(key: str, w, tp_ax):
     return PpWeight(spec(inner.ndim, role))
 
 
+def _pp_scaffold(mesh, layers, cfg, b):
+    """Shared scaffolding for the manual-pp execution schemes (all-stages
+    and GPipe): axis derivation, per-leaf in/out specs, and the shard_map
+    wiring — one place so the two schedules cannot drift.
+
+    Inside the fully-manual region the layer math runs per-shard: the
+    explicit shard_map wrappers must not re-enter (tp_mesh=None) and
+    matmul/attention dispatch on manual_tp instead."""
+    from jax import shard_map
+
+    from .mesh import DP_AXIS
+
+    pp = mesh.shape[PP_AXIS]
+    tp = mesh.shape.get(TP_AXIS, 1)
+    dp = mesh.shape.get(DP_AXIS, 1)
+    n_slot = len(layers)
+    inner_cfg = {**cfg, "tp_mesh": None, "manual_tp": tp}
+    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
+    tp_ax = TP_AXIS if tp > 1 else None
+    layer_specs = [{k: _leaf_in_spec(k, w, tp_ax) for k, w in lw.items()}
+                   for lw in layers]
+    cache_spec = (P(PP_AXIS, dp_ax, tp_ax),) * n_slot
+    x_spec = P(dp_ax)
+
+    def wrap(body):
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(x_spec, x_spec, layer_specs, cache_spec, cache_spec),
+            out_specs=(x_spec, cache_spec, cache_spec),
+            check_vma=False)
+
+    return pp, tp, n_slot, inner_cfg, wrap
+
+
 def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
     """Run all L layers across the pp stages; returns (x, k_all, v_all).
 
@@ -184,22 +232,10 @@ def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
     are (pp, B, KVH, S, hs), sharded over pp on the stage axis and tp on
     the kv-head axis (cache_pspec(pp=True)).
     """
-    from jax import shard_map
-
     from ..models.transformer import _layer
-    from .mesh import DP_AXIS
 
-    pp = mesh.shape[PP_AXIS]
-    tp = mesh.shape.get(TP_AXIS, 1)
-    n_slot = len(layers)
-    # inside the fully-manual region the layer math runs per-shard: the
-    # explicit shard_map wrappers must not re-enter (tp_mesh=None) and
-    # matmul/attention dispatch on manual_tp instead
-    inner_cfg = {**cfg, "tp_mesh": None, "manual_tp": tp}
-    dp = mesh.shape.get(DP_AXIS, 1)
-    b = x.shape[0]
-    dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
-    tp_ax = TP_AXIS if tp > 1 else None
+    pp, tp, n_slot, inner_cfg, wrap = _pp_scaffold(mesh, layers, cfg,
+                                                   x.shape[0])
 
     def body(x_l, q_pos_l, layers_l, k_l, v_l):
         p = lax.axis_index(PP_AXIS)
@@ -221,13 +257,89 @@ def pp_layers(x, layers, spec, cache, q_pos, cfg, mesh, per_row_pos=False):
             x_l = manual_psum(live, PP_AXIS)
         return x_l, tuple(k_l), tuple(v_l)
 
-    layer_specs = [{k: _leaf_in_spec(k, w, tp_ax) for k, w in lw.items()}
-                   for lw in layers]
-    cache_spec = (P(PP_AXIS, dp_ax, tp_ax),) * n_slot
-    x_spec = P(dp_ax)
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(x_spec, x_spec, layer_specs, cache_spec, cache_spec),
-        out_specs=(x_spec, cache_spec, cache_spec),
-        check_vma=False)
-    return fn(x, q_pos, layers, cache.k, cache.v)
+    return wrap(body)(x, q_pos, layers, cache.k, cache.v)
+
+
+def gpipe_microbatches(t: int, pp: int) -> int:
+    """Microbatch count for a T-token segment: 1 means "use the all-stages
+    scheme". GPipe engages only for flop-bound segments (>= 32 tokens per
+    stage — decode and speculative verify stay all-stages, they are
+    weight-read-bound and rotation would re-read weights); M is the
+    largest divisor of T in [pp, 4*pp] capped at T/32, trading bubble
+    fraction (M+pp-1)/M against per-microbatch weight re-reads."""
+    if pp <= 1 or t < 32 * pp:
+        return 1
+    for m in range(min(4 * pp, t // 32), pp - 1, -1):
+        if t % m == 0:
+            return m
+    return 1
+
+
+def pp_layers_gpipe(x, layers, spec, cache, q_pos, cfg, mesh, n_mb,
+                    per_row_pos=False):
+    """GPipe sequence-microbatch prefill across the pp stages; same
+    signature/contract as pp_layers plus `n_mb` (from gpipe_microbatches,
+    > 1, dividing T). Returns (x, k_all, v_all) with x fully assembled
+    (B, T, dim) — logits_for_all / logit_index callers read any position.
+
+    Schedule: at step t (static, t in [0, M+pp-1)), the device at stage p
+    runs microbatch m = t - p when 0 <= m < M. Stage 0 reads its
+    microbatch straight from the embedded input; other stages consume the
+    activation ppermute'd from stage p-1 at the end of the previous step;
+    stage pp-1 deposits its result into the output buffer. Bubble steps
+    (m out of range) compute on stale data with cache writes gated off
+    and their results discarded — SPMD-uniform control flow, like
+    pp_layers' off-turn iterations, but each device runs only its OWN
+    layers, so the wall is (M+pp-1) microbatch-stage computes instead of
+    M*pp."""
+    from ..models.transformer import _layer
+
+    pp, tp, n_slot, inner_cfg, wrap = _pp_scaffold(mesh, layers, cfg,
+                                                   x.shape[0])
+    t = x.shape[1]
+    assert n_mb > 1 and t % n_mb == 0, (t, n_mb)
+    t_mb = t // n_mb
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def shift(y):
+        # activation hop stage p -> p+1; stage 0 receives zeros (unused —
+        # it always reads the embedded input). f32 transit on CPU for the
+        # same reason as manual_psum.
+        if jax.default_backend() == "cpu" and y.dtype == jnp.bfloat16:
+            return lax.ppermute(y.astype(jnp.float32), PP_AXIS,
+                                perm).astype(y.dtype)
+        return lax.ppermute(y, PP_AXIS, perm)
+
+    def body(x_l, q_pos_l, layers_l, k_l, v_l):
+        p = lax.axis_index(PP_AXIS)
+        k_l = list(k_l)
+        v_l = list(v_l)
+        lws = [{k: _unwrap0(k, w, tp) for k, w in layers_l[j].items()}
+               for j in range(n_slot)]
+        act = jnp.zeros((x_l.shape[0], t_mb, x_l.shape[2]), x_l.dtype)
+        out = jnp.zeros_like(x_l)
+        for step in range(n_mb + pp - 1):
+            m = step - p                # this device's microbatch index
+            valid = (m >= 0) & (m < n_mb)
+            off = jnp.clip(m, 0, n_mb - 1) * t_mb
+            inp = jnp.where(p == 0,
+                            lax.dynamic_slice_in_dim(x_l, off, t_mb, 1),
+                            act)
+            q_mb = lax.dynamic_slice_in_dim(q_pos_l, off, t_mb, 1)
+            y = inp
+            for j in range(n_slot):
+                y, k_new, v_new = _layer(
+                    y, lws[j], spec, k_l[j][0], v_l[j][0], q_mb, inner_cfg,
+                    per_row_pos=per_row_pos, write_gate=valid)
+                k_l[j] = k_new[None]
+                v_l[j] = v_new[None]
+            # only the last stage's (valid) results reach the output; all
+            # other devices keep out == 0, so one psum replicates at the end
+            cur = lax.dynamic_slice_in_dim(out, off, t_mb, 1)
+            out = lax.dynamic_update_slice_in_dim(
+                out, jnp.where((p == pp - 1) & valid, y, cur), off, 1)
+            if step < n_mb + pp - 2:  # the last step's hop is dead
+                act = shift(y)
+        return manual_psum(out, PP_AXIS), tuple(k_l), tuple(v_l)
+
+    return wrap(body)(x, q_pos, layers, cache.k, cache.v)

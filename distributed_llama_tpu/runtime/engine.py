@@ -58,12 +58,16 @@ class Engine:
         # and measured slower)
         use_pallas: bool | None = None,
         pallas_interpret: bool = False,
+        pp_gpipe: bool = True,  # GPipe sequence-microbatch prefill on pp
+        # meshes (parallel/pp.py:pp_layers_gpipe); False pins the
+        # all-stages scheme everywhere (A/B knob)
         model_fingerprint: int = 0,  # content hash of the weights the
         # session fingerprint folds in (io.model_file.content_fingerprint);
         # 0 = unknown (in-memory params) — such sessions only check shapes
     ):
         self.mesh = mesh
         self.batch = batch
+        self.pp_gpipe = pp_gpipe
         self.model_fingerprint = int(model_fingerprint)
         self.seq_len = min(max_seq_len or spec.seq_len, spec.seq_len)
         self.compute_dtype = compute_dtype
@@ -395,6 +399,7 @@ class Engine:
             pallas_interpret=self.pallas_interpret,
             sp_cache_mesh=self._sp_cache_mesh,
             pp_mesh=self._pp_mesh,
+            pp_gpipe=self.pp_gpipe,
         )
 
     def _compiled_step(self, key, *, sp_mesh=None,
