@@ -111,13 +111,16 @@ def build_argparser() -> argparse.ArgumentParser:
                         "Output streams after the loop. Net-new: the "
                         "reference samples on CPU every token")
     p.add_argument("--lookup-decode", type=int, default=0, metavar="K",
-                   help="greedy speculative decoding: draft up to K tokens "
-                        "per step from the context's own n-grams and verify "
+                   help="speculative decoding: draft up to K tokens per "
+                        "step from the context's own n-grams and verify "
                         "them in ONE forward (prompt lookup — decode is "
                         "weight-read-bound on TPU, so confirmed draft "
-                        "tokens are nearly free). Token stream is exactly "
-                        "the greedy stream; requires --temperature 0. "
-                        "Net-new: the reference is strictly 1 token/forward")
+                        "tokens are nearly free). At --temperature 0 the "
+                        "token stream is exactly the greedy stream; at "
+                        "temperature > 0 tokens are accepted/resampled "
+                        "rejection-style, distribution-exact vs the host "
+                        "sampler (different RNG stream). Net-new: the "
+                        "reference is strictly 1 token/forward")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -301,12 +304,9 @@ def cmd_generate(args, benchmark: bool) -> None:
         sys.exit("error: --device-sampling does not compose with "
                  "--nnodes (the worker protocol drives generate())")
     if args.lookup_decode:
-        if args.temperature != 0:
-            sys.exit("error: --lookup-decode is exact for greedy decoding "
-                     "only — pass --temperature 0")
         if args.nnodes > 1 or args.dp > 1 or args.device_sampling:
             sys.exit("error: --lookup-decode is single-sequence host-loop "
-                     "greedy; it does not compose with --nnodes/--dp/"
+                     "decoding; it does not compose with --nnodes/--dp/"
                      "--device-sampling")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
@@ -367,11 +367,23 @@ def cmd_generate(args, benchmark: bool) -> None:
     if args.lookup_decode:
         t0 = time.time()
         with _maybe_profile(args):
-            res = engine.generate_lookup(
-                tokens, _steps(args, engine),
-                eos_id=tokenizer.stop_token_ids(),
-                draft_len=args.lookup_decode, on_token=on_token,
-                vocab_size=tokenizer.vocab_size)
+            if args.temperature > 0:
+                # sampled speculation: distribution-exact via rejection
+                # resampling (Engine.generate_lookup_sampled) — NOT
+                # xorshift-stream-parity with the plain sampled loop
+                res = engine.generate_lookup_sampled(
+                    tokens, _steps(args, engine),
+                    temperature=args.temperature, topp=args.topp,
+                    seed=sampler.rng_state,
+                    eos_id=tokenizer.stop_token_ids(),
+                    draft_len=args.lookup_decode, on_token=on_token,
+                    vocab_size=tokenizer.vocab_size)
+            else:
+                res = engine.generate_lookup(
+                    tokens, _steps(args, engine),
+                    eos_id=tokenizer.stop_token_ids(),
+                    draft_len=args.lookup_decode, on_token=on_token,
+                    vocab_size=tokenizer.vocab_size)
         dt = time.time() - t0
         print()
         if benchmark:
@@ -464,11 +476,10 @@ def cmd_chat(args) -> None:
     """Interactive chat with the Llama-2 template (ref: dllama.cpp:133-178)."""
     import os
 
-    if args.lookup_decode and (args.temperature != 0 or args.nnodes > 1):
+    if args.lookup_decode and args.nnodes > 1:
         # same loud guard as generate mode — a silently ignored flag is
         # worse than an error
-        sys.exit("error: --lookup-decode is exact for greedy decoding only "
-                 "(pass --temperature 0) and does not compose with --nnodes")
+        sys.exit("error: --lookup-decode does not compose with --nnodes")
     if args.session and (args.nnodes > 1 or args.pp > 1):
         # save_session fetches the cache to the host — impossible for a
         # multi-process mesh (non-addressable shards) and unsupported for
@@ -520,14 +531,24 @@ def cmd_chat(args) -> None:
         budget = min(_steps(args, engine), remaining)
         convo.extend(tokens)
         if args.lookup_decode:
-            # greedy chat turns speculate (exact same token stream), mining
-            # drafts from the WHOLE conversation so far — prior turns are
-            # the richest n-gram source
-            res = engine.generate_lookup(tokens, budget, eos_id=stops,
-                                         draft_len=args.lookup_decode,
-                                         on_token=on_token,
-                                         vocab_size=tokenizer.vocab_size,
-                                         history=convo)
+            # chat turns speculate, mining drafts from the WHOLE
+            # conversation so far — prior turns are the richest n-gram
+            # source. Greedy turns are token-stream-exact; sampled turns
+            # are distribution-exact (rejection resampling)
+            if args.temperature > 0:
+                res = engine.generate_lookup_sampled(
+                    tokens, budget, temperature=args.temperature,
+                    topp=args.topp, seed=sampler.rng_state, eos_id=stops,
+                    draft_len=args.lookup_decode, on_token=on_token,
+                    vocab_size=tokenizer.vocab_size, history=convo)
+                # advance the shared seed so the next turn draws fresh
+                sampler.set_seed(sampler.rng_state + len(res.tokens) + 1)
+            else:
+                res = engine.generate_lookup(tokens, budget, eos_id=stops,
+                                             draft_len=args.lookup_decode,
+                                             on_token=on_token,
+                                             vocab_size=tokenizer.vocab_size,
+                                             history=convo)
             convo.extend(res.tokens)
         else:
             _announce_run(tokens, budget, sampler=sampler)

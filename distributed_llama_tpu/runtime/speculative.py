@@ -54,6 +54,56 @@ def find_draft(
     return []
 
 
+def target_dist(logits: np.ndarray, temperature: float, topp: float,
+                vocab_size: int) -> np.ndarray:
+    """The host Sampler's per-token sampling distribution, materialized:
+    temperature softmax, then the reference's top-p nucleus (cutoff
+    pre-filter, stable-descending sort, truncate at cumulative > topp
+    INCLUDING the crossing element, renormalize — ref:
+    src/tokenizer.cpp:265-306 and sampler.py:_sample_topp). Sampling from
+    this vector is distribution-identical to Sampler.sample on the same
+    logits, which is what makes rejection resampling exact."""
+    from ..sampler import topp_nucleus
+
+    logits = np.asarray(logits, np.float32).reshape(-1)[:vocab_size]
+    x = logits / temperature
+    x = np.exp(x - x.max())
+    probs = x / x.sum()
+    if topp <= 0 or topp >= 1:
+        return probs.astype(np.float64)
+    order, cum, last = topp_nucleus(probs, topp)
+    out = np.zeros(probs.shape[0], np.float64)
+    out[order[: last + 1]] = probs[order[: last + 1]] / cum[last]
+    return out
+
+
+def draw(p: np.ndarray, u: float) -> int:
+    """Sample index ~ p given one uniform u in [0, 1)."""
+    cdf = np.cumsum(p)
+    idx = int(np.searchsorted(cdf, u * cdf[-1], side="right"))
+    return min(idx, len(p) - 1)
+
+
+def accept_or_resample(p: np.ndarray, d: int, u_accept: float,
+                       u_res: float) -> tuple[bool, int]:
+    """One rejection-resampling step against a DETERMINISTIC draft token d
+    (prompt-lookup drafts are point masses, q = onehot(d), so the usual
+    min(1, p/q) acceptance reduces to p(d)): accept d with probability
+    p(d); on reject, sample from the residual (p with d zeroed,
+    renormalized). Marginal over (u_accept, u_res) is exactly p — the
+    distribution-exactness the sampled lookup mode rests on.
+    Returns (accepted, token)."""
+    pd = float(p[d])
+    if u_accept < pd:
+        return True, d
+    r = p.copy()
+    r[d] = 0.0
+    s = r.sum()
+    if s <= 0.0:  # p was a point mass at d — rejection is impossible
+        return True, d
+    return False, draw(r, u_res)
+
+
 def count_accepted(draft: list[int], greedy: np.ndarray) -> int:
     """How many leading draft tokens the verify forward confirmed: greedy[i]
     is the model's argmax AFTER segment position i, so draft token i (fed at

@@ -20,6 +20,30 @@ import numpy as np
 from .utils.rng import xorshift_f32
 
 
+def topp_nucleus(probs: np.ndarray, topp: float):
+    """The reference's top-p nucleus (ref: src/tokenizer.cpp:265-306):
+    cutoff pre-filter, stable-descending sort, truncation index at
+    cumulative > topp INCLUDING the crossing element. Returns (order,
+    cum, last) — token ids sorted by prob, float64 cumulative mass, and
+    the inclusive truncation index. Shared by Sampler._sample_topp and
+    the speculative target_dist so the rejection-resampling mode's
+    distribution-exactness cannot drift from the sampler."""
+    n = probs.shape[0]
+    cutoff = (1.0 - topp) / (n - 1)
+    cand = np.nonzero(probs >= cutoff)[0]
+    if cand.size == 0:
+        # near-uniform probs with topp < 1/n can leave no candidate
+        # (the reference would read out of bounds here); keep the
+        # (first) argmax so the nucleus is never empty — mirrored by
+        # the native twin and the device sampler
+        cand = np.array([int(np.argmax(probs))])
+    order = cand[np.argsort(-probs[cand], kind="stable")]
+    cum = np.cumsum(probs[order].astype(np.float64))
+    over = np.nonzero(cum > topp)[0]
+    last = int(over[0]) if over.size else len(order) - 1
+    return order, cum, last
+
+
 class Sampler:
     def __init__(self, vocab_size: int, temperature: float, topp: float,
                  seed: int, backend: str = "auto"):
@@ -85,24 +109,10 @@ class Sampler:
         return min(idx, self.vocab_size - 1)
 
     def _sample_topp(self, probs: np.ndarray, coin: float) -> int:
-        # ref: src/tokenizer.cpp:265-306 — cutoff pre-filter, sort descending,
-        # truncate at cumulative > topp, then sample within the truncated mass.
-        n = probs.shape[0]
-        cutoff = (1.0 - self.topp) / (n - 1)
-        cand = np.nonzero(probs >= cutoff)[0]
-        if cand.size == 0:
-            # near-uniform probs with topp < 1/n can leave no candidate
-            # (the reference would read out of bounds here); keep the
-            # (first) argmax so the nucleus is never empty — mirrored by
-            # the native twin and the device sampler
-            cand = np.array([int(np.argmax(probs))])
-        order = cand[np.argsort(-probs[cand], kind="stable")]
-        p = probs[order]
-        cum = np.cumsum(p.astype(np.float64))
-        over = np.nonzero(cum > self.topp)[0]
-        last = int(over[0]) if over.size else len(order) - 1
-        total = cum[last]
-        r = coin * total
+        # sample within the truncated nucleus mass (topp_nucleus holds the
+        # construction, shared with speculative.target_dist)
+        order, cum, last = topp_nucleus(probs, self.topp)
+        r = coin * cum[last]
         idx = int(np.searchsorted(cum[: last + 1], r, side="right"))
         idx = min(idx, last)
         return int(order[idx])
