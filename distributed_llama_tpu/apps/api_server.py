@@ -25,6 +25,7 @@ stdlib http.server, no external deps.
 from __future__ import annotations
 
 import json
+import sys
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
@@ -180,6 +181,40 @@ def _completion_chunks(state: ApiState, body: dict):
                     "completion_tokens": emitted})
 
 
+def load_server_session(state: ApiState, path: str) -> None:
+    """Restore a previous server process's prefix cache + token history
+    (Engine.load_session — refuses a mismatched model via the content
+    fingerprint). A follow-up request whose prompt extends the saved
+    conversation then re-prefills only its suffix, and the response is
+    byte-identical to the no-restart path (net-new — the reference resets
+    all state per request AND per process, ref: dllama-api.cpp:236-249)."""
+    tokens = state.engine.load_session(path)
+    # the cache holds K/V for exactly engine.pos positions; tokens beyond
+    # that (a chat's final unstepped token) must not count as cached
+    state.cached_tokens = tokens[: state.engine.pos]
+
+
+def save_server_session(state: ApiState, path: str) -> bool:
+    """Persist the live prefix cache + its token history
+    (Engine.save_session). Called on server shutdown — the cache fetch is
+    O(pos * layers * kv_dim) host bytes, too heavy per-request for big
+    models but free at exit.
+
+    A shutdown landing mid-request (client disconnect, signal) leaves
+    cached_tokens empty while engine.pos is large — saving then would
+    clobber a previously good file with an unusable one, so the save is
+    SKIPPED (False) and any prior file stays; it is self-consistent (its
+    cache bytes came from the file's own tokens) even though the live
+    engine moved past it. The cache is also never saved beyond the token
+    history that describes it."""
+    if not state.cached_tokens:
+        return False
+    eng = state.engine
+    eng.pos = min(eng.pos, len(state.cached_tokens))
+    eng.save_session(path, tokens=state.cached_tokens)
+    return True
+
+
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -310,14 +345,40 @@ def make_handler(state: ApiState):
 
 
 def serve(args) -> None:
-    from .dllama import build_engine
+    import os
+    import signal
+    import threading
+
+    from .dllama import build_engine, check_session_flags
+
+    session = getattr(args, "session", None)
+    check_session_flags(args)
+    if session and threading.current_thread() is threading.main_thread():
+        # non-interactive shutdown (docker stop, systemd) sends SIGTERM,
+        # whose default handler exits WITHOUT unwinding the stack — the
+        # finally below would never save. Convert it to SystemExit so the
+        # save runs for service deployments too.
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
     engine, tokenizer, sampler = build_engine(args)
     state = ApiState(engine, tokenizer, sampler,
                      lookup_decode=getattr(args, "lookup_decode", 0))
+    if session and os.path.exists(session):
+        load_server_session(state, session)
+        print(f"💾 resumed session from {session} "
+              f"({engine.pos} cached positions)")
     server = HTTPServer((args.host, args.port), make_handler(state))
     print(f"🔌 dllama-api listening on {args.host}:{args.port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
+        pass
+    finally:
         server.server_close()
+        if session:
+            if save_server_session(state, session):
+                print(f"💾 saved session to {session} "
+                      f"({engine.pos} cached positions)")
+            else:
+                print("💾 no completed session to save "
+                      f"(leaving {session} untouched)")

@@ -92,11 +92,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "kernels")
     p.add_argument("--system-prompt", default=None, help="chat mode system prompt")
     p.add_argument("--session", default=None, metavar="FILE",
-                   help="chat mode: persist the KV-cache session to FILE "
-                        "after every turn and resume from it on start — a "
-                        "chat survives process restarts without "
-                        "re-prefilling its history (net-new: the reference "
-                        "has no session persistence, SURVEY.md §5.4)")
+                   help="chat/api modes: persist the KV-cache session to "
+                        "FILE (chat: after every turn; api: on shutdown) "
+                        "and resume from it on start — a conversation "
+                        "survives process restarts without re-prefilling "
+                        "its history (net-new: the reference has no "
+                        "session persistence, SURVEY.md §5.4)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the generation to DIR "
                         "(view with tensorboard/xprof; net-new — the "
@@ -247,6 +248,16 @@ def build_engine(args):
         seed = broadcast_seed(seed)
     sampler = Sampler(tokenizer.vocab_size, args.temperature, args.topp, seed)
     return engine, tokenizer, sampler
+
+
+def check_session_flags(args) -> None:
+    """--session needs a host-fetchable, stage-flat KV cache:
+    save_session fetches it to the host — impossible for a multi-process
+    mesh (non-addressable shards) and unsupported for stage-stacked pp
+    caches. Shared by the chat CLI and the API server so the constraint
+    cannot diverge; fails before any engine work."""
+    if getattr(args, "session", None) and (args.nnodes > 1 or args.pp > 1):
+        sys.exit("error: --session does not compose with --nnodes or --pp")
 
 
 def _steps(args, engine) -> int:
@@ -480,11 +491,7 @@ def cmd_chat(args) -> None:
         # same loud guard as generate mode — a silently ignored flag is
         # worse than an error
         sys.exit("error: --lookup-decode does not compose with --nnodes")
-    if args.session and (args.nnodes > 1 or args.pp > 1):
-        # save_session fetches the cache to the host — impossible for a
-        # multi-process mesh (non-addressable shards) and unsupported for
-        # stage-stacked pp caches; fail before the first turn, not after it
-        sys.exit("error: --session does not compose with --nnodes or --pp")
+    check_session_flags(args)
     engine, tokenizer, sampler = build_engine(args)
     convo: list[int] = []  # whole-conversation tokens: the draft miner's
     # n-gram source (chat history is full of quotable n-grams)

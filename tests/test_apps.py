@@ -196,6 +196,69 @@ def test_api_prefix_reuse_matches_stateless(tmp_path, rng):
     assert len(prefills) == 2 and 0 < prefills[1] < full_len, prefills
 
 
+def test_api_session_survives_restart(tmp_path, rng):
+    """API session persistence (VERDICT r3 weak #6): serve request A, save
+    the session (the server's shutdown path), rebuild the server process
+    state, load the session, then serve A + a follow-up — the follow-up
+    must prefill ONLY the suffix beyond the restored prefix and its
+    response must be byte-identical to the no-restart path."""
+    from distributed_llama_tpu.apps.api_server import (
+        _completion_chunks, build_chat_prompt, load_server_session,
+        save_server_session)
+
+    from distributed_llama_tpu.testing import write_fixture
+
+    # the two-turn conversation runs ~272 prompt tokens — needs more
+    # context than the shared 192-token fixture
+    mpath, tpath = write_fixture(tmp_path, rng=rng, seq_len=384)
+    spath = str(tmp_path / "api_session.npz")
+
+    def build_state():
+        args = dllama.build_argparser().parse_args([
+            "api", "--model", mpath, "--tokenizer", tpath,
+            "--steps", "8", "--temperature", "0", "--seed", "3"])
+        engine, tokenizer, sampler = dllama.build_engine(args)
+        return ApiState(engine, tokenizer, sampler, model_name="tiny")
+
+    def body(messages):
+        return {"messages": messages, "max_tokens": 4, "temperature": 0}
+
+    msgs_a = [{"role": "system", "content": "abba"},
+              {"role": "user", "content": "ab"}]
+    # the follow-up extends the same conversation (assistant turn + new
+    # user turn share the A prefix)
+    msgs_b = msgs_a + [{"role": "assistant", "content": "x"},
+                       {"role": "user", "content": "ba"}]
+
+    # no-restart oracle: one state serves A then the follow-up
+    ref = build_state()
+    want_a = list(_completion_chunks(ref, body(msgs_a)))
+    want_b = list(_completion_chunks(ref, body(msgs_b)))
+
+    # restart path: serve A, save (shutdown), new process state, load
+    s1 = build_state()
+    got_a = list(_completion_chunks(s1, body(msgs_a)))
+    assert got_a == want_a
+    save_server_session(s1, spath)
+
+    s2 = build_state()
+    load_server_session(s2, spath)
+    assert s2.engine.pos == s1.engine.pos
+    prefills = []
+    orig = s2.engine.prefill
+
+    def spy(suffix):
+        prefills.append(len(suffix))
+        return orig(suffix)
+
+    s2.engine.prefill = spy
+    got_b = list(_completion_chunks(s2, body(msgs_b)))
+    assert got_b == want_b  # byte-identical to the no-restart path
+    # only the suffix beyond the restored prefix was prefilled
+    n_full = len(s2.tokenizer.encode(build_chat_prompt(msgs_b)))
+    assert len(prefills) == 1 and 0 < prefills[0] < n_full, (prefills, n_full)
+
+
 def test_api_bad_json(api_server):
     host, port = api_server
     conn = http.client.HTTPConnection(host, port, timeout=60)
