@@ -288,6 +288,27 @@ def test_generate_batch_matches_independent_runs():
     assert outs == refs
 
 
+def test_generate_batch_sampled_reproducible_and_distinct():
+    """Sampled batch generation (vectorized host sampler): a fixed seed
+    reproduces exactly; identical prompts still diverge because the
+    shared interleaved xorshift stream gives each row different coins."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=13)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    prompts = [[1, 5, 9]] * 3
+
+    def run():
+        s = Sampler(spec.vocab_size, temperature=0.9, topp=0.9, seed=5,
+                    backend="python")
+        eng = Engine(spec, params, batch=3, compute_dtype=jnp.float32,
+                     cache_dtype=jnp.float32)
+        return eng.generate_batch(prompts, max_tokens=8, sampler=s)
+
+    a, b = run(), run()
+    assert a == b  # deterministic for a fixed seed
+    assert len({tuple(r) for r in a}) > 1  # interleaved stream: rows differ
+
+
 def test_generate_batch_eos_stops_row():
     """A row sampling the stop token halts while other rows continue."""
     spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
