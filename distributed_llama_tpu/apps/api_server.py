@@ -209,16 +209,9 @@ def save_server_session(state: ApiState, path: str) -> bool:
     history that describes it."""
     if not state.cached_tokens:
         return False
-    import os
-
     eng = state.engine
     eng.pos = min(eng.pos, len(state.cached_tokens))
-    # write-then-rename: the save fetches the whole cache (seconds for big
-    # models) and a second signal mid-write must not leave a truncated
-    # file where a good one stood (same pattern as converters/download.py)
-    tmp = path + ".tmp"
-    eng.save_session(tmp, tokens=state.cached_tokens)
-    os.replace(tmp, path)
+    eng.save_session(path, tokens=state.cached_tokens)  # atomic (tmp+rename)
     return True
 
 

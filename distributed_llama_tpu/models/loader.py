@@ -224,10 +224,11 @@ class _PpStacker:
     per-device footprint is the final L/pp share plus one transient host
     tensor — never the full-L restack the engine-side path pays."""
 
-    def __init__(self, mesh, pp: int, tp: int = 1):
+    def __init__(self, mesh, pp: int, tp: int = 1, ep: int = 1):
         self.mesh = mesh
         self.pp = pp
         self.tp = tp
+        self.ep = ep
 
         @functools.partial(jax.jit, donate_argnums=0, static_argnums=3)
         def update(buf, row, stage, sharding):
@@ -254,17 +255,60 @@ class _PpStacker:
             ts: list[HostTensor], *, keep_f32: bool = False):
         """Fold one layer tensor (or fused/expert-stacked group) into the
         slot's stage-stacked leaf."""
+        from ..parallel.ep_moe import (EpColWeight, EpRowWeight, ep_col_pspec,
+                                       ep_row_pspec)
         from ..parallel.pp import PpWeight
         from ..parallel.tp_q80 import TpColWeight
 
         cur = slot.get(key)
+        moe_ep = self.ep > 1 and key in _MOE_EP_KEYS
         if mode != "q40" or keep_f32:
             x = _dense_host_stack(ts)
             leaf_dtype = jnp.float32 if keep_f32 else dtype
+            if moe_ep and key in COL_SPLIT_NAMES:
+                # ep x pp dense moe_down: (tp, E, d, n/tp) col stack per
+                # stage — PpWeight(EpColWeight(...)), mirroring _Placer
+                n = x.shape[-1]
+                xs = np.ascontiguousarray(np.moveaxis(
+                    x.reshape(*x.shape[:-1], self.tp, n // self.tp), -2, 0))
+                old = cur.w.w if cur is not None else None
+                slot[key] = PpWeight(EpColWeight(self._row(
+                    old, xs, stage, ep_col_pspec(xs.ndim), leaf_dtype)))
+                return
+            if moe_ep:
+                old = cur.w.w if cur is not None else None
+                slot[key] = PpWeight(EpRowWeight(self._row(
+                    old, x, stage, ep_row_pspec(x.ndim), leaf_dtype)))
+                return
             spec = _pspec_for(key, x.ndim, False, "dense")
             slot[key] = PpWeight(self._row(
                 cur.w if cur is not None else None, x, stage, spec,
                 leaf_dtype))
+            return
+        if moe_ep and key in COL_SPLIT_NAMES:
+            # ep x pp q40 moe_down: block-aligned (tp, E, d, ...) col
+            # stack, stage-stacked — PpWeight(EpColWeight(QuantizedTensor))
+            packed, scales = _q40_raw_stack(ts)
+            pk, sc = _col_q40_host(packed, scales, self.tp)
+            old = cur.w.w if cur is not None else None
+            slot[key] = PpWeight(EpColWeight(QuantizedTensor(
+                self._row(old.packed if old is not None else None, pk,
+                          stage, ep_col_pspec(pk.ndim), pk.dtype),
+                self._row(old.scales if old is not None else None, sc,
+                          stage, ep_col_pspec(sc.ndim), sc.dtype),
+            )))
+            return
+        if moe_ep:
+            # ep x pp q40 moe_up/moe_gate: expert-stacked rows, experts on
+            # ep — PpWeight(EpRowWeight(QuantizedTensor))
+            pk, sc = _q40_host_stack(ts)
+            old = cur.w.w if cur is not None else None
+            slot[key] = PpWeight(EpRowWeight(QuantizedTensor(
+                self._row(old.packed if old is not None else None, pk,
+                          stage, ep_row_pspec(pk.ndim), pk.dtype),
+                self._row(old.scales if old is not None else None, sc,
+                          stage, ep_row_pspec(sc.ndim), sc.dtype),
+            )))
             return
         if key in COL_SPLIT_NAMES and self.tp > 1:
             # pp's fully-manual region slices weights at placement: q40 col
@@ -347,11 +391,11 @@ def load_params_streamed(
         fuse = tp == 1
     if pp > 1:
         assert spec.n_layers % pp == 0, (spec.n_layers, pp)
-        assert not q80_collectives and ep == 1, (
-            "pp loading composes with tp/dp only (matching Engine)")
+        assert not q80_collectives, (
+            "pp loading uses exact reduces (matching Engine)")
     n_slot = spec.n_layers // pp
     placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep)
-    pp_stack = _PpStacker(mesh, pp, tp=tp) if pp > 1 else None
+    pp_stack = _PpStacker(mesh, pp, tp=tp, ep=ep) if pp > 1 else None
 
     p: dict = {"layers": [dict() for _ in range(n_slot if pp > 1
                                                 else spec.n_layers)]}

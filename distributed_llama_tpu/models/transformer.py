@@ -270,6 +270,22 @@ def _moe_ffn(xb, lw, spec: ModelSpec, cfg):
         # expert-parallel placement (ep mesh axis): each ep shard computes
         # only its local experts, masked by the scattered routing weights
         e_weights = scatter_weights()
+        if cfg.get("manual_tp"):
+            # already inside a fully-manual region (pp — parallel/pp.py):
+            # shard_map cannot nest, so the ep body runs directly with the
+            # region's manual (ep, tp) axes
+            from ..parallel.ep_moe import _ep_body
+
+            return _ep_body(
+                xb, e_weights, lw["moe_up"].w, lw["moe_gate"].w,
+                lw["moe_down"].w,
+                ep=cfg.get("manual_ep") or 1, tp=cfg["manual_tp"],
+                act_fn=lambda g: apply_hidden_act(g, spec.hidden_act),
+                compute_dtype=cfg["compute_dtype"],
+                use_pallas=cfg.get("use_pallas", False),
+                interpret=cfg.get("pallas_interpret", False),
+                reduce=cfg.get("tp_reduce", "exact"),
+            ).astype(xb.dtype)
         return ep_moe_ffn(
             xb, e_weights, lw, cfg["tp_mesh"],
             act_fn=lambda g: apply_hidden_act(g, spec.hidden_act),

@@ -64,6 +64,9 @@ def matmul(
     tp_reduce: str = "exact",
     pallas_interpret: bool = False,
     manual_tp: int = 0,
+    manual_ep: int = 0,  # carried in the pp region's cfg for the MoE
+    # block (ep_moe._ep_body); dense matmuls ignore it — ep shards only
+    # the expert axis, every other weight is replicated across ep
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
@@ -135,6 +138,7 @@ def fused_expert_matmul(
     tp_reduce: str = "exact",
     pallas_interpret: bool = False,
     manual_tp: int = 0,
+    manual_ep: int = 0,  # ignored — see matmul()
 ):
     """Expert-indexed matmul against a stacked (E, d, n) Q40 weight without
     materializing the expert's slice (ops/pallas_q40.q40_expert_matmul).

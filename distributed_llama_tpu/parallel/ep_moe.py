@@ -134,36 +134,14 @@ def ep_moe_ffn(
     tp = mesh.shape.get(TP_AXIS, 1)
     e_total = e_weights.shape[-1]
     assert e_total % ep == 0, (e_total, ep)
-    e_local = e_total // ep
     dp_ax, sp_ax = _batch_axes(mesh, xb)
     x_spec = P(dp_ax, sp_ax, None)
 
     def body(x_l, ew_l, up_l, gate_l, down_l):
-        ep_idx = lax.axis_index(EP_AXIS) if ep > 1 else 0
-        down_w = down_l.w
-        acc = jnp.zeros(x_l.shape[:-1] + (down_w.packed.shape[-2]
-                        if isinstance(down_w, QuantizedTensor)
-                        else down_w.shape[-2],), compute_dtype)
-        for le in range(e_local):
-            ge = ep_idx * e_local + le
-            w_e = lax.dynamic_index_in_dim(ew_l, ge, axis=-1, keepdims=True)
-            gate = local_matmul(x_l, _take2(gate_l.w, le),
-                                compute_dtype=compute_dtype,
-                                use_pallas=use_pallas, interpret=interpret)
-            up = local_matmul(x_l, _take2(up_l.w, le),
-                              compute_dtype=compute_dtype,
-                              use_pallas=use_pallas, interpret=interpret)
-            hb = act_fn(gate) * up
-            down_le = _take2(down_w, 0)       # drop the tp stack axis
-            down_le = _take2(down_le, le)     # then the local expert axis
-            out = local_matmul(hb, down_le, compute_dtype=compute_dtype,
-                               use_pallas=use_pallas, interpret=interpret)
-            acc = acc + w_e.astype(out.dtype) * out
-        if reduce == "q80" and tp > 1:
-            acc = q80_psum_2shot(acc, TP_AXIS, tp)
-            return lax.psum(acc, EP_AXIS) if ep > 1 else acc
-        axes = tuple(ax for ax, n in ((EP_AXIS, ep), (TP_AXIS, tp)) if n > 1)
-        return lax.psum(acc, axes) if axes else acc
+        return _ep_body(x_l, ew_l, up_l.w, gate_l.w, down_l.w,
+                        ep=ep, tp=tp, act_fn=act_fn,
+                        compute_dtype=compute_dtype, use_pallas=use_pallas,
+                        interpret=interpret, reduce=reduce)
 
     fn = shard_map(
         body, mesh=mesh,
@@ -171,3 +149,43 @@ def ep_moe_ffn(
                   _row_pspec(lw["moe_gate"]), _col_pspec(lw["moe_down"])),
         out_specs=x_spec, check_vma=False)
     return fn(xb, e_weights, lw["moe_up"], lw["moe_gate"], lw["moe_down"])
+
+
+def _ep_body(x_l, ew_l, up_w, gate_w, down_w, *, ep, tp, act_fn,
+             compute_dtype, use_pallas, interpret, reduce):
+    """The per-shard expert-parallel MoE computation (local shapes): each
+    device runs its E/ep local experts masked by the replicated routing
+    weights and the partial sums reduce over (ep, tp). Called from
+    ep_moe_ffn's shard_map body AND directly inside the fully-manual pp
+    region (parallel/pp.py — shard_map cannot nest, so ep under pp must be
+    manual exactly like tp is)."""
+    e_total = ew_l.shape[-1]
+    e_local = e_total // ep
+    ep_idx = lax.axis_index(EP_AXIS) if ep > 1 else 0
+    acc = jnp.zeros(x_l.shape[:-1] + (down_w.packed.shape[-2]
+                    if isinstance(down_w, QuantizedTensor)
+                    else down_w.shape[-2],), compute_dtype)
+    for le in range(e_local):
+        ge = ep_idx * e_local + le
+        w_e = lax.dynamic_index_in_dim(ew_l, ge, axis=-1, keepdims=True)
+        gate = local_matmul(x_l, _take2(gate_w, le),
+                            compute_dtype=compute_dtype,
+                            use_pallas=use_pallas, interpret=interpret)
+        up = local_matmul(x_l, _take2(up_w, le),
+                          compute_dtype=compute_dtype,
+                          use_pallas=use_pallas, interpret=interpret)
+        hb = act_fn(gate) * up
+        down_le = _take2(down_w, 0)       # drop the tp stack axis
+        down_le = _take2(down_le, le)     # then the local expert axis
+        out = local_matmul(hb, down_le, compute_dtype=compute_dtype,
+                           use_pallas=use_pallas, interpret=interpret)
+        acc = acc + w_e.astype(out.dtype) * out
+    from .tp_q80 import manual_psum
+
+    # manual_psum: f32 transit for bf16 payloads on the CPU backend (the
+    # same XLA CPU manual-region miscompile the pp stage broadcast hits)
+    if reduce == "q80" and tp > 1:
+        acc = q80_psum_2shot(acc, TP_AXIS, tp)
+        return manual_psum(acc, EP_AXIS) if ep > 1 else acc
+    axes = tuple(ax for ax, n in ((EP_AXIS, ep), (TP_AXIS, tp)) if n > 1)
+    return manual_psum(acc, axes) if axes else acc

@@ -110,10 +110,13 @@ def stack_stages(params: dict, pp: int) -> dict:
     assert n_l % pp == 0, (n_l, pp)
     n_slot = n_l // pp
 
+    from .ep_moe import EpColWeight, EpRowWeight
+
     def stack(leaves):
         if isinstance(leaves[0], PpWeight):  # already stacked
             return leaves[0]
-        if isinstance(leaves[0], (TpRowWeight, TpColWeight)):
+        if isinstance(leaves[0], (TpRowWeight, TpColWeight,
+                                  EpRowWeight, EpColWeight)):
             inner = _stack_leaves([w.w for w in leaves])
             return PpWeight(type(leaves[0])(inner))
         return PpWeight(_stack_leaves(leaves))
@@ -133,6 +136,8 @@ def _unwrap0(key: str, w, tp: int):
     manual region, yielding this device's local layer weight. Plain split
     leaves are re-marked TpRowWeight/TpColWeight by their _SPLIT role so
     matmul(manual_tp=...) knows whether a psum is owed."""
+    from .ep_moe import EpColWeight, EpRowWeight
+
     inner = w.w
 
     def strip(v, n_axes):
@@ -145,6 +150,11 @@ def _unwrap0(key: str, w, tp: int):
             v = v[0]
         return v
 
+    if isinstance(inner, (EpRowWeight, EpColWeight)):
+        # ep x pp: strip the stage axis only — the inner layout (local
+        # experts; for cols also the local tp stack) is exactly what the
+        # manual ep body consumes (ep_moe._ep_body)
+        return type(inner)(strip(inner.w, 1))
     if isinstance(inner, TpColWeight):
         return TpColWeight(strip(inner.w, 2))   # stage + tp stack axes
     if isinstance(inner, TpRowWeight):
@@ -169,7 +179,19 @@ def _leaf_in_spec(key: str, w, tp_ax):
             axes[(ndim - 1) - 2 if role == "row" else (ndim - 1) - 1] = tp_ax
         return P(PP_AXIS, *axes)
 
+    from .ep_moe import EpColWeight, EpRowWeight, ep_col_pspec, ep_row_pspec
+
     inner = w.w
+    if isinstance(inner, (EpRowWeight, EpColWeight)):
+        # ep x pp: the stage axis prepends the Ep layout's own spec
+        ep_ps = ep_row_pspec if isinstance(inner, EpRowWeight) else ep_col_pspec
+
+        def espec(ndim):
+            return P(PP_AXIS, *ep_ps(ndim - 1))
+        if isinstance(inner.w, QuantizedTensor):
+            return PpWeight(type(inner)(QuantizedTensor(
+                espec(inner.w.packed.ndim), espec(inner.w.scales.ndim))))
+        return PpWeight(type(inner)(espec(inner.w.ndim)))
     if isinstance(inner, TpColWeight):
         def cspec(ndim):
             return P(PP_AXIS, tp_ax, *([None] * (ndim - 2)))
@@ -200,13 +222,14 @@ def _pp_scaffold(mesh, layers, cfg, b):
     matmul/attention dispatch on manual_tp instead."""
     from jax import shard_map
 
-    from .mesh import DP_AXIS
+    from .mesh import DP_AXIS, EP_AXIS
 
     pp = mesh.shape[PP_AXIS]
     tp = mesh.shape.get(TP_AXIS, 1)
     dp = mesh.shape.get(DP_AXIS, 1)
     n_slot = len(layers)
-    inner_cfg = {**cfg, "tp_mesh": None, "manual_tp": tp}
+    inner_cfg = {**cfg, "tp_mesh": None, "manual_tp": tp,
+                 "manual_ep": mesh.shape.get(EP_AXIS, 1)}
     dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
     tp_ax = TP_AXIS if tp > 1 else None
     layer_specs = [{k: _leaf_in_spec(k, w, tp_ax) for k, w in lw.items()}

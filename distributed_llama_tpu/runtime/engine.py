@@ -148,7 +148,9 @@ class Engine:
             assert spec.n_layers % pp == 0, (
                 f"pp={pp} must divide n_layers={spec.n_layers}")
             assert sp == 1, "pp does not compose with sp yet"
-            assert ep == 1, "pp does not compose with ep yet"
+            # ep composes: experts placed across ep INSIDE the manual pp
+            # region (each device holds L/pp stages x E/ep experts — the
+            # Grok-class scaling layout; parallel/pp.py + ep_moe._ep_body)
             assert not self.q80_collectives, (
                 "pp uses exact tp reduces; --buffer-float-type q80 "
                 "is not supported with --pp")
@@ -175,10 +177,14 @@ class Engine:
             check_tp_constraints(spec, tp, q40=q40)
             if ep > 1:
                 from ..parallel.ep_moe import EpRowWeight, repack_moe_ep
+                from ..parallel.pp import PpWeight
 
                 params = dict(params)
                 params["layers"] = [
-                    lw if isinstance(lw.get("moe_up"), EpRowWeight)
+                    # PpWeight = the streamed loader's stage stack, whose
+                    # ep mode already built PpWeight(Ep...) leaves
+                    lw if isinstance(lw.get("moe_up"),
+                                     (EpRowWeight, PpWeight))
                     else repack_moe_ep(lw, tp)
                     for lw in params["layers"]
                 ]
@@ -278,10 +284,17 @@ class Engine:
                 elif arr.dtype not in (np.float32, np.float64):
                     arr = arr.view(np.uint16)
                 data[f"{name}{l}"] = arr
-        # open handle: np.savez(str_path) appends ".npz" to extension-less
-        # names, which load_session/os.path.exists would then never find
-        with open(path, "wb") as f:
+        # write-then-rename: the cache fetch makes this a seconds-long write
+        # for big models, and a signal landing mid-write must never leave a
+        # truncated file where a good session stood (chat saves every turn).
+        # Open handle: np.savez(str_path) appends ".npz" to extension-less
+        # names, which load_session/os.path.exists would then never find.
+        import os
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             np.savez(f, **data)
+        os.replace(tmp, path)
 
     def load_session(self, path: str) -> list[int]:
         """Restore a save_session() file: refuses a mismatched model/engine

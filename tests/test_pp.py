@@ -291,6 +291,69 @@ def test_unfuse_inverts_fuse_exactly():
     assert unfuse_layer_weights(back, spec) is back
 
 
+@pytest.mark.parametrize("pp,ep,tp", [(2, 2, 2), (2, 2, 1), (2, 4, 1)])
+def test_pp_ep_moe_matches_single_device(pp, ep, tp):
+    """ep composes with pp (the Grok-class scaling layout: L/pp stages x
+    E/ep experts x tp per device): decode AND the GPipe microbatch prefill
+    must reproduce the single-device stream, and each device must hold
+    only its stage's local experts."""
+    spec, params = make_params(ArchType.MIXTRAL, "q40")
+    want = baseline_tokens(spec, params)
+    eng = Engine(spec, params, make_mesh(pp=pp, ep=ep, tp=tp),
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                 use_pallas=False)
+    up = eng.params["layers"][0]["moe_up"].w.w
+    shard = up.packed.sharding.shard_shape(up.packed.shape)
+    assert shard[0] == 1 and shard[1] == spec.n_experts // ep, shard
+    got = eng.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+    assert got == want, (got, want)
+
+    # GPipe prefill through the ep x pp region (long prompt engages it)
+    long = _long_prompt(64)
+    spec_l = make_spec(ArchType.MIXTRAL, dim=128, n_heads=8, n_kv_heads=4,
+                       hidden_dim=256, n_layers=4, seq_len=96)
+    host, _ = dense_weights(spec_l, seed=7)
+    params_l = load_params(spec_l, host, mode="q40", dtype=jnp.float32)
+    want_l = baseline_tokens(spec_l, params_l, long, n=4)
+    eng_l = Engine(spec_l, params_l, make_mesh(pp=pp, ep=ep, tp=tp),
+                   compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+                   use_pallas=False)
+    got_l = eng_l.generate(long, max_tokens=4, sampler=greedy()).tokens
+    assert got_l == want_l, (got_l, want_l)
+
+
+def test_pp_ep_streamed_loader_places_expert_stages(tmp_path):
+    """The streamed loader builds PpWeight(Ep...) leaves directly for
+    ep x pp meshes — per-device load memory is the L/pp x E/ep share —
+    and the loaded engine reproduces the single-device stream."""
+    import dataclasses
+
+    from distributed_llama_tpu.io.model_file import write_model
+    from distributed_llama_tpu.models.loader import load_params_streamed
+    from distributed_llama_tpu.quants.types import FloatType
+
+    spec = make_spec(ArchType.MIXTRAL, dim=128, n_heads=8, n_kv_heads=4,
+                     hidden_dim=256, n_layers=4, seq_len=64)
+    host, _ = dense_weights(spec, seed=7)
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    want = baseline_tokens(spec, params)
+
+    qspec = dataclasses.replace(spec, weights_float_type=FloatType.Q40)
+    mp = str(tmp_path / "m.m")
+    write_model(mp, qspec, {n: t.to_f32() for n, t in host.items()})
+    mesh = make_mesh(pp=2, ep=2, tp=2)
+    sp_params, lstats = load_params_streamed(qspec, mp, mesh, mode="q40",
+                                             dtype=jnp.float32)
+    assert lstats.peak_host_bytes < lstats.total_bytes
+    eng = Engine(spec, sp_params, mesh, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32, use_pallas=False)
+    up = eng.params["layers"][0]["moe_up"].w.w
+    shard = up.packed.sharding.shard_shape(up.packed.shape)
+    assert shard[:2] == (1, spec.n_experts // 2), shard
+    got = eng.generate(PROMPT, max_tokens=6, sampler=greedy()).tokens
+    assert got == want, (got, want)
+
+
 def test_pp_rejects_unsupported_combos():
     spec, params = make_params()
     with pytest.raises(AssertionError, match="sp"):
