@@ -96,6 +96,23 @@ def _to_cache_dtype(x, dtype):
     return x.astype(dtype)
 
 
+def _scatter_cache_write(k_cache, v_cache, k, v, idx, write_gate):
+    """Drop-mode scatter of (B, T, KVH, hs) K/V at per-position indices
+    (B, T) into (B, KVH, S, hs) caches. write_gate (traced bool) pushes
+    gated-off writes to the out-of-bounds slot S, which scatter drops —
+    shared by the batched per-row write path and the manual-sp chunk-local
+    write path so the OOB-gating idiom cannot diverge."""
+    oob = k_cache.shape[2]
+    if write_gate is not None:
+        idx = jnp.where(write_gate, idx, oob)
+    bidx = jnp.arange(k_cache.shape[0], dtype=jnp.int32)[:, None]
+    k_cache = k_cache.at[bidx, :, idx].set(
+        _to_cache_dtype(k, k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[bidx, :, idx].set(
+        _to_cache_dtype(v, v_cache.dtype), mode="drop")
+    return k_cache, v_cache
+
+
 def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
                      sp_mesh=None, sp_cache_mesh=None, per_row_pos=False,
                      write_gate=None):
@@ -136,22 +153,34 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
 
     # functional cache update at positions q_pos (contiguous per row:
     # pos[b]..pos[b]+T); cache is head-major (B, KVH, S, hs) — see KVCache
+    sp_n = cfg.get("manual_sp") or 1
+    if sp_n > 1:
+        # fully-manual pp region with an sp-sharded cache: this device
+        # holds the S/sp chunk starting at sp_index * s_local. Writes go
+        # through a per-position scatter at chunk-LOCAL indices; positions
+        # owned by other devices (and bubble-step writes, write_gate) are
+        # pushed to the OOB slot — scatter drops them. Negative local
+        # indices would WRAP, not drop, so they are clamped to OOB first.
+        from ..parallel.mesh import SP_AXIS as _SP
+        from ..parallel.ring_attention import sp_cache_attention_local
+
+        s_local = k_cache.shape[2]
+        local = q_pos - lax.axis_index(_SP) * s_local
+        local = jnp.where(local < 0, s_local, local)
+        k_cache, v_cache = _scatter_cache_write(k_cache, v_cache, k, v,
+                                                local, write_gate)
+        att = sp_cache_attention_local(q, k_cache, v_cache, q_pos)
+        out = matmul(att.reshape(b, t, h * hs), lw["wo"], **cfg)
+        return out, k_cache, v_cache
     if per_row_pos:
         # batched generation: each sequence writes at its own position
-        # (net-new vs the reference's batch=1 — SURVEY.md §2.5 DP row)
-        bidx = jnp.arange(b, dtype=jnp.int32)[:, None]
-        if write_gate is not None:
-            # gate by pushing the write index out of bounds when it is not
-            # this stage's turn — scatter drops OOB updates (cheaper than a
-            # read-modify-write, and XLA's partitioner handles the scatter
-            # where it miscompiles the equivalent gather under manual pp)
-            q_write = jnp.where(write_gate, q_pos, k_cache.shape[2])
-        else:
-            q_write = q_pos
-        k_cache = k_cache.at[bidx, :, q_write].set(
-            _to_cache_dtype(k, k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[bidx, :, q_write].set(
-            _to_cache_dtype(v, v_cache.dtype), mode="drop")
+        # (net-new vs the reference's batch=1 — SURVEY.md §2.5 DP row).
+        # Gated (pp off-turn) writes are pushed out of bounds and dropped
+        # by the scatter — cheaper than a read-modify-write, and XLA's
+        # partitioner handles the scatter where it miscompiles the
+        # equivalent gather under manual pp.
+        k_cache, v_cache = _scatter_cache_write(k_cache, v_cache, k, v,
+                                                q_pos, write_gate)
     else:
         pos0 = q_pos[:, 0]
         k_w = _to_cache_dtype(k.transpose(0, 2, 1, 3), k_cache.dtype)

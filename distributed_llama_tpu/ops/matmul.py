@@ -67,6 +67,8 @@ def matmul(
     manual_ep: int = 0,  # carried in the pp region's cfg for the MoE
     # block (ep_moe._ep_body); dense matmuls ignore it — ep shards only
     # the expert axis, every other weight is replicated across ep
+    manual_sp: int = 0,  # likewise: sp shards only the KV cache's
+    # sequence dim (transformer._attention_block), never a matmul operand
 ) -> jnp.ndarray:
     """y[..., d] = sum_n x[..., n] * W[d, n].
 
@@ -139,6 +141,7 @@ def fused_expert_matmul(
     pallas_interpret: bool = False,
     manual_tp: int = 0,
     manual_ep: int = 0,  # ignored — see matmul()
+    manual_sp: int = 0,  # ignored — see matmul()
 ):
     """Expert-indexed matmul against a stacked (E, d, n) Q40 weight without
     materializing the expert's slice (ops/pallas_q40.q40_expert_matmul).

@@ -222,19 +222,25 @@ def _pp_scaffold(mesh, layers, cfg, b):
     matmul/attention dispatch on manual_tp instead."""
     from jax import shard_map
 
-    from .mesh import DP_AXIS, EP_AXIS
+    from .mesh import DP_AXIS, EP_AXIS, SP_AXIS
 
     pp = mesh.shape[PP_AXIS]
     tp = mesh.shape.get(TP_AXIS, 1)
     dp = mesh.shape.get(DP_AXIS, 1)
+    sp = mesh.shape.get(SP_AXIS, 1)
     n_slot = len(layers)
     inner_cfg = {**cfg, "tp_mesh": None, "manual_tp": tp,
-                 "manual_ep": mesh.shape.get(EP_AXIS, 1)}
+                 "manual_ep": mesh.shape.get(EP_AXIS, 1),
+                 "manual_sp": sp}
     dp_ax = DP_AXIS if dp > 1 and b % dp == 0 else None
     tp_ax = TP_AXIS if tp > 1 else None
     layer_specs = [{k: _leaf_in_spec(k, w, tp_ax) for k, w in lw.items()}
                    for lw in layers]
-    cache_spec = (P(PP_AXIS, dp_ax, tp_ax),) * n_slot
+    # cache leaves are (pp, B, KVH, S, hs): stage on pp, kv-heads on tp,
+    # and — when sp > 1 — the sequence dim on sp (per-device cache memory
+    # seq_len/sp, the long-context axis composing with stage placement)
+    cache_spec = (P(PP_AXIS, dp_ax, tp_ax,
+                    SP_AXIS if sp > 1 else None),) * n_slot
     x_spec = P(dp_ax)
 
     def wrap(body):

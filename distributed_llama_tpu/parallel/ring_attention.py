@@ -139,37 +139,47 @@ def sp_cache_attention(q, k_cache, v_cache, q_pos, mesh, axis_name: str = SP_AXI
     from .mesh import DP_AXIS, TP_AXIS
 
     n = mesh.shape[axis_name]
-    s = k_cache.shape[2]
-    assert s % n == 0, (s, n)
-    s_local = s // n
+    assert k_cache.shape[2] % n == 0, (k_cache.shape, n)
     tp = TP_AXIS if TP_AXIS in mesh.axis_names else None
-    b, t, h, hs = q.shape
-    scale = 1.0 / (hs ** 0.5)
 
     q_spec = P(DP_AXIS, None, tp, None)
     cache_spec = P(DP_AXIS, tp, axis_name, None)
     pos_spec = P(DP_AXIS, None)
 
     def body(q_l, k_l, v_l, qp_l):
-        idx = lax.axis_index(axis_name)
-        bl = q_l.shape[0]
-        k_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)[None, :]
-        k_pos = jnp.broadcast_to(k_pos, (bl, s_local))
-        kt = k_l.transpose(0, 2, 1, 3)  # (B, S_l, KVH, hs) — _block_attn layout
-        vt = v_l.transpose(0, 2, 1, 3)
-        acc, m, l = _block_attn(q_l, kt, vt, qp_l, k_pos, scale)
-        # exact online-softmax merge across the sp chunks
-        m_max = lax.pmax(m, axis_name)
-        m_safe = jnp.where(jnp.isfinite(m_max), m_max, 0.0)
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        num = lax.psum(acc * alpha[..., None], axis_name)
-        den = lax.psum(l * alpha, axis_name)
-        return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q_l.dtype)
+        return sp_cache_attention_local(q_l, k_l, v_l, qp_l,
+                                        axis_name=axis_name)
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(q_spec, cache_spec, cache_spec, pos_spec),
                    out_specs=q_spec, check_vma=False)
     return fn(q, k_cache, v_cache, q_pos)
+
+
+def sp_cache_attention_local(q_l, k_l, v_l, qp_l, axis_name: str = SP_AXIS):
+    """The per-shard body of sp_cache_attention (local shapes: the cache's
+    sequence dim is this device's S/sp chunk, queries replicated): local
+    flash stats + the exact pmax/psum online-softmax merge. Called from
+    sp_cache_attention's shard_map AND directly inside the fully-manual pp
+    region (parallel/pp.py — shard_map cannot nest, so sp under pp runs
+    manually exactly like tp and ep do)."""
+    s_local = k_l.shape[2]
+    hs = q_l.shape[-1]
+    scale = 1.0 / (hs ** 0.5)
+    idx = lax.axis_index(axis_name)
+    bl = q_l.shape[0]
+    k_pos = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)[None, :]
+    k_pos = jnp.broadcast_to(k_pos, (bl, s_local))
+    kt = k_l.transpose(0, 2, 1, 3)  # (B, S_l, KVH, hs) — _block_attn layout
+    vt = v_l.transpose(0, 2, 1, 3)
+    acc, m, l = _block_attn(q_l, kt, vt, qp_l, k_pos, scale)
+    # exact online-softmax merge across the sp chunks
+    m_max = lax.pmax(m, axis_name)
+    m_safe = jnp.where(jnp.isfinite(m_max), m_max, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    num = lax.psum(acc * alpha[..., None], axis_name)
+    den = lax.psum(l * alpha, axis_name)
+    return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q_l.dtype)
 
 
 def ring_attention(q, k, v, mesh, pos0: int = 0, axis_name: str = SP_AXIS):

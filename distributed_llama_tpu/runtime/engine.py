@@ -147,10 +147,12 @@ class Engine:
         if pp > 1:
             assert spec.n_layers % pp == 0, (
                 f"pp={pp} must divide n_layers={spec.n_layers}")
-            assert sp == 1, "pp does not compose with sp yet"
             # ep composes: experts placed across ep INSIDE the manual pp
             # region (each device holds L/pp stages x E/ep experts — the
-            # Grok-class scaling layout; parallel/pp.py + ep_moe._ep_body)
+            # Grok-class scaling layout; parallel/pp.py + ep_moe._ep_body).
+            # sp composes too: the cache's sequence dim shards over sp
+            # inside the region (scatter writes at chunk-local slots, flash
+            # stats merged over sp — transformer._attention_block manual_sp)
             assert not self.q80_collectives, (
                 "pp uses exact tp reduces; --buffer-float-type q80 "
                 "is not supported with --pp")
@@ -503,8 +505,10 @@ class Engine:
         sharded over sp (long-context path, net-new vs the reference)."""
         assert self.batch == 1, "prefill() is single-sequence; use step() for batches"
         sp = self.mesh.shape.get(SP_AXIS, 1) if self.mesh is not None else 1
-        if (sp > 1 and self.pos == 0 and len(prompt) > 1
+        if (sp > 1 and self._pp == 1 and self.pos == 0 and len(prompt) > 1
                 and len(prompt) + (-len(prompt)) % sp <= self.seq_len):
+            # (under pp, prefill goes through the GPipe microbatch schedule
+            # instead; the sp-sharded cache is written chunk-locally there)
             return self._prefill_ring(prompt, sp)
         logits = None
         i = 0
