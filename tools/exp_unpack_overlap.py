@@ -108,16 +108,42 @@ def main():
 
     fl = 2 * T * D * N
     variants = [("current", lambda v: q.q40_matmul(v, w, out_dtype=jnp.bfloat16))]
+    # tile sizes must divide D = 11008 = 2^8 * 43 exactly — a flooring
+    # grid would silently skip rows and bias the comparison (td=512 would
+    # cover only 97.7% of the output) — and both the tile and its
+    # sub-slices must stay 32-row aligned (the uint8 sublane tile)
+    combos = ((128, 2), (128, 4), (256, 2), (256, 4), (256, 8), (2752, 2))
+    assert all(D % td == 0 and td % 32 == 0 and (td // ns) % 32 == 0
+               for td, ns in combos), combos
     variants += [(f"td={td} n_sub={ns}",
                   lambda v, td=td, ns=ns: matmul_sub(v, w, ns, td))
-                 for td, ns in ((512, 2), (512, 4))]
-    for name, fn in variants:
-        run = chain(fn)
+                 for td, ns in combos]
+    # the tunneled platform's run-to-run jitter is ±30%: variants are only
+    # comparable INTERLEAVED in one process, best-of-N each (the repo's
+    # A/B measurement discipline)
+    runs = [(name, chain(fn)) for name, fn in variants]
+    best: dict = {}
+    for name, run in runs:
         np.asarray(run(x))  # compile
-        t0 = time.perf_counter()
-        np.asarray(run(x))
-        dt = (time.perf_counter() - t0) / 8
-        print(f"{name}: {dt*1e3:.3f} ms/call, {fl/dt/1e12:.1f} TFLOP/s")
+    for _ in range(4):
+        for name, run in runs:
+            t0 = time.perf_counter()
+            np.asarray(run(x))
+            dt = (time.perf_counter() - t0) / 8
+            best[name] = min(best.get(name, dt), dt)
+    base = best["current"]
+    for name, _ in runs:
+        dt = best[name]
+        rel = base / dt
+        print(f"{name}: {dt*1e3:.3f} ms/call, {fl/dt/1e12:.1f} TFLOP/s, "
+              f"{rel:.2f}x vs current")
+    winner = min(best, key=best.get)
+    if winner != "current" and base / best[winner] > 1.10:
+        print(f"DECISION: {winner} beats current by >10% — thread n_sub "
+              "through pallas_q40._kernel's mxu_bf16 mode")
+    else:
+        print("DECISION: no variant beats current by >10% — record the "
+              "negative result in ops/pallas_q40.py and keep the kernel")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,29 @@
+#!/bin/sh
+# Hardware runbook: everything to run the moment the TPU tunnel is back.
+# The round-3/4 tunnel outages repeatedly ate the measurement window, so
+# the sequence is ordered by information-per-chip-minute:
+#   1. bounded liveness probe (never hang the shell)
+#   2. tools/exp_unpack_overlap.py — the known ~40%-MFU prefill headroom
+#      experiment (minutes; interleaved best-of-N inside one process)
+#   3. full default bench — 7B decode + prefill + 8k bf16/f8 + lookup +
+#      MoE rows (~95 min; each row flushes to stderr as it is measured,
+#      so a mid-run outage keeps completed rows)
+# Artifacts land in tools/artifacts/ for the README/BENCH refresh.
+set -e
+cd "$(dirname "$0")/.."
+mkdir -p tools/artifacts
+
+echo "== probe (120s bound) =="
+if ! timeout 120 python -c "import jax; print(jax.devices())"; then
+    echo "TPU backend unavailable — rerun when the tunnel is back" >&2
+    exit 1
+fi
+
+echo "== unpack/MXU overlap experiment =="
+PYTHONPATH=. timeout 1800 python tools/exp_unpack_overlap.py \
+    2>&1 | tee tools/artifacts/overlap_$(date +%H%M).txt
+
+echo "== full default bench =="
+timeout 10800 python bench.py \
+    2> tools/artifacts/bench_rows_$(date +%H%M).jsonl \
+    | tee tools/artifacts/bench_$(date +%H%M).json
