@@ -272,15 +272,18 @@ def _safe_print(piece: str) -> None:
 
 
 def _announce_run(tokens: list[int], max_tokens: int, reset: bool = False,
-                  sampler=None) -> None:
+                  sampler=None, lookup: int = 0) -> None:
     """Root side of the multi-host protocol: tell worker processes to enter
-    the same generate() call (no-op single-process)."""
+    the same generate() call (no-op single-process). lookup > 0 replays a
+    speculative run — deterministic draft mining keeps the verify shapes
+    in lock-step."""
     if jax.process_count() > 1:
         from ..parallel import multihost as mh
         mh.send_run(tokens, max_tokens,
                     sampler.rng_state if sampler else 0,
                     sampler.temperature if sampler else 0.0,
-                    sampler.topp if sampler else 0.0, reset)
+                    sampler.topp if sampler else 0.0, reset,
+                    lookup=lookup)
 
 
 import contextlib
@@ -315,9 +318,9 @@ def cmd_generate(args, benchmark: bool) -> None:
         sys.exit("error: --device-sampling does not compose with "
                  "--nnodes (the worker protocol drives generate())")
     if args.lookup_decode:
-        if args.nnodes > 1 or args.dp > 1 or args.device_sampling:
+        if args.dp > 1 or args.device_sampling:
             sys.exit("error: --lookup-decode is single-sequence host-loop "
-                     "decoding; it does not compose with --nnodes/--dp/"
+                     "decoding; it does not compose with --dp/"
                      "--device-sampling")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
@@ -376,15 +379,23 @@ def cmd_generate(args, benchmark: bool) -> None:
         prev[0] = tok
 
     if args.lookup_decode:
+        _announce_run(tokens, _steps(args, engine), sampler=sampler,
+                      lookup=args.lookup_decode)
         t0 = time.time()
         with _maybe_profile(args):
             if args.temperature > 0:
                 # sampled speculation: distribution-exact via rejection
                 # resampling (Engine.generate_lookup_sampled) — NOT
-                # xorshift-stream-parity with the plain sampled loop
+                # xorshift-stream-parity with the plain sampled loop.
+                # temperature/topp go through the same float32 roundtrip
+                # the cluster header applies: a worker seeing
+                # 0.69999998807 where the root used 0.7 could flip one
+                # accept decision, diverge the verify widths, and hang a
+                # cross-host collective
                 res = engine.generate_lookup_sampled(
                     tokens, _steps(args, engine),
-                    temperature=args.temperature, topp=args.topp,
+                    temperature=float(np.float32(args.temperature)),
+                    topp=float(np.float32(args.topp)),
                     seed=sampler.rng_state,
                     eos_id=tokenizer.stop_token_ids(),
                     draft_len=args.lookup_decode, on_token=on_token,
@@ -590,18 +601,38 @@ def cmd_worker(args) -> None:
         if msg.kind == mh.MSG_RUN:
             if msg.reset:
                 engine.reset()
-            # sample with the ROOT's params and rng state from the header —
-            # immune to any sampler-flag mismatch between the processes
-            from ..sampler import Sampler
-            run_sampler = Sampler(tokenizer.vocab_size, msg.temperature,
-                                  msg.topp, msg.seed)
-            if engine.batch > 1:
-                engine.generate_batch([msg.tokens] * engine.batch,
-                                      msg.max_tokens, run_sampler,
-                                      eos_id=stops)
+            if msg.lookup:
+                # speculative replay: drafts mine the replicated token
+                # stream, so every process computes the same verify widths
+                # (send_run's lock-step contract); the sampled mode's
+                # rejection draws come from the header seed — identical
+                # numpy streams on every process
+                if msg.temperature > 0:
+                    engine.generate_lookup_sampled(
+                        msg.tokens, msg.max_tokens,
+                        temperature=msg.temperature, topp=msg.topp,
+                        seed=msg.seed, eos_id=stops,
+                        draft_len=msg.lookup,
+                        vocab_size=tokenizer.vocab_size)
+                else:
+                    engine.generate_lookup(msg.tokens, msg.max_tokens,
+                                           eos_id=stops,
+                                           draft_len=msg.lookup,
+                                           vocab_size=tokenizer.vocab_size)
             else:
-                engine.generate(msg.tokens, msg.max_tokens, run_sampler,
-                                eos_id=stops)
+                # sample with the ROOT's params and rng state from the
+                # header — immune to any sampler-flag mismatch between
+                # the processes
+                from ..sampler import Sampler
+                run_sampler = Sampler(tokenizer.vocab_size, msg.temperature,
+                                      msg.topp, msg.seed)
+                if engine.batch > 1:
+                    engine.generate_batch([msg.tokens] * engine.batch,
+                                          msg.max_tokens, run_sampler,
+                                          eos_id=stops)
+                else:
+                    engine.generate(msg.tokens, msg.max_tokens, run_sampler,
+                                    eos_id=stops)
         elif msg.kind == mh.MSG_API:
             # replay the root's API request end-to-end from the raw body —
             # prompt build, sampling, stop scan are all deterministic

@@ -46,8 +46,8 @@ MSG_XFER_BENCH = 3  # join a measure_transfer_ms() collective microbench
 MSG_SEED = 5      # startup handshake: cluster-wide sampler seed
 
 # [kind, n_payload, payload_is_bytes, max_tokens, seed_lo, seed_hi,
-#  temp_bits, topp_bits, reset]
-_HEADER_LEN = 9
+#  temp_bits, topp_bits, reset, lookup]
+_HEADER_LEN = 10
 
 
 def init_multihost(coordinator: str, num_processes: int, process_id: int) -> int:
@@ -85,7 +85,7 @@ class RunMsg:
     def __init__(self, kind: int, tokens=None, body: bytes | None = None,
                  ints=None, max_tokens: int = 0, seed: int = 0,
                  temperature: float = 0.0, topp: float = 0.0,
-                 reset: bool = False):
+                 reset: bool = False, lookup: int = 0):
         self.kind = kind
         self.tokens = tokens
         self.body = body
@@ -94,12 +94,13 @@ class RunMsg:
         self.seed = seed
         self.temperature = temperature
         self.topp = topp
+        self.lookup = lookup
         self.reset = reset
 
 
 def _send(kind: int, *, int_payload=None, bytes_payload: bytes | None = None,
           max_tokens: int = 0, seed: int = 0, temperature: float = 0.0,
-          topp: float = 0.0, reset: bool = False) -> None:
+          topp: float = 0.0, reset: bool = False, lookup: int = 0) -> None:
     assert int_payload is None or bytes_payload is None
     n = (len(int_payload) if int_payload is not None
          else len(bytes_payload) if bytes_payload is not None else 0)
@@ -109,6 +110,7 @@ def _send(kind: int, *, int_payload=None, bytes_payload: bytes | None = None,
         int(np.float32(temperature).view(np.int32)),
         int(np.float32(topp).view(np.int32)),
         int(reset),
+        int(lookup),
     ]
     _bcast(np.asarray(header, np.int64))
     if int_payload is not None:
@@ -128,6 +130,7 @@ def recv_msg() -> RunMsg:
         temperature=float(np.int32(h[6]).view(np.float32)),
         topp=float(np.int32(h[7]).view(np.float32)),
         reset=bool(h[8]),
+        lookup=int(h[9]),
     )
     if n:
         if is_bytes:
@@ -142,12 +145,16 @@ def recv_msg() -> RunMsg:
 # -- root-side senders -----------------------------------------------------
 
 def send_run(tokens: list[int], max_tokens: int, seed: int,
-             temperature: float, topp: float, reset: bool = False) -> None:
+             temperature: float, topp: float, reset: bool = False,
+             lookup: int = 0) -> None:
     """Root: announce one generate() run. seed carries the root sampler's
     CURRENT rng state, so workers reproduce the token stream even when
-    their own sampler flags differ."""
+    their own sampler flags differ. lookup > 0 = the run speculates with
+    that draft length: drafts are mined from the (replicated) token
+    stream, so every process mines the SAME drafts and the verify-forward
+    shapes stay in lock-step across the cluster."""
     _send(MSG_RUN, int_payload=tokens, max_tokens=max_tokens, seed=seed,
-          temperature=temperature, topp=topp, reset=reset)
+          temperature=temperature, topp=topp, reset=reset, lookup=lookup)
 
 
 def send_api(body_json: bytes) -> None:

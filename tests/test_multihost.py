@@ -84,6 +84,34 @@ def test_two_process_cluster_matches_single(tmp_path):
     assert "root shut down" in out_worker
 
 
+def test_two_process_cluster_lookup_decode(tmp_path):
+    """--lookup-decode over a 2-process cluster: drafts are mined from the
+    replicated token stream, so both processes compute the same verify
+    widths in lock-step and the transcript matches the single-process
+    speculative run (the worker replays via the MSG_RUN lookup field)."""
+    mpath, tpath = _fixture(tmp_path)
+    base = ["--model", mpath, "--tokenizer", tpath, "--prompt", "abab",
+            "--steps", "8", "--seed", "7", "--temperature", "0",
+            "--buffer-float-type", "f32", "--lookup-decode", "5"]
+
+    p, t = _run(["generate", *base])
+    out_single, err = p.communicate(timeout=t)
+    assert p.returncode == 0, err
+
+    port = _free_port()
+    cluster = ["--nnodes", "2", "--coordinator", f"127.0.0.1:{port}"]
+    root, t = _run(["generate", *base, *cluster, "--node-rank", "0"])
+    worker, _ = _run(["worker", "--model", mpath, "--tokenizer", tpath,
+                      "--temperature", "0", "--buffer-float-type", "f32",
+                      *cluster, "--node-rank", "1"])
+    out_root, err_root = root.communicate(timeout=t)
+    out_worker, err_worker = worker.communicate(timeout=t)
+    assert root.returncode == 0, (out_root, err_root)
+    assert worker.returncode == 0, (out_worker, err_worker)
+    assert _gen_line(out_root) == _gen_line(out_single), (
+        out_root, out_single)
+
+
 def _post_completion(port: int, body: dict, deadline: float = 240.0) -> dict:
     """POST /v1/chat/completions, retrying until the server accepts."""
     import http.client
