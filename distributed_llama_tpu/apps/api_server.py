@@ -140,10 +140,12 @@ def _completion_chunks(state: ApiState, body: dict):
             history.append(tok)  # stepping tok wrote its K/V
 
     # greedy requests can speculate: prompt-lookup drafts verified in one
-    # forward (exact greedy stream — runtime/speculative.py). Single-process
-    # only, like the prefix reuse above.
-    use_lookup = (state.lookup_decode > 0 and sampler.temperature == 0.0
-                  and jax.process_count() == 1)
+    # forward (exact greedy stream — runtime/speculative.py). Safe on
+    # multi-host clusters too: prefix reuse is off there, so every process
+    # replays the identical request from token 0 and mines identical
+    # drafts — same verify widths, collectives in lock-step (the
+    # --lookup-decode flag itself is in the cluster config fingerprint)
+    use_lookup = state.lookup_decode > 0 and sampler.temperature == 0.0
     history = list(tokens)  # every prompt position is written by prefill
     try:
         if use_lookup:

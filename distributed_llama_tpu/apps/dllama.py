@@ -199,7 +199,11 @@ def build_engine(args):
                       # flags (MSG_RUN headers carry them, MSG_API doesn't)
                       # — a mismatch would silently diverge token streams
                       int(np.float32(args.temperature).view(np.int32)),
-                      int(np.float32(args.topp).view(np.int32))])
+                      int(np.float32(args.topp).view(np.int32)),
+                      # API-mode speculation likewise uses each process's
+                      # own --lookup-decode: a mismatch would diverge the
+                      # verify-forward widths and hang a collective
+                      args.lookup_decode])
 
     mesh = None
     if (args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1
@@ -640,7 +644,8 @@ def cmd_worker(args) -> None:
 
             from .api_server import ApiState, PromptTooLong, _completion_chunks
             if api_state is None:
-                api_state = ApiState(engine, tokenizer, sampler)
+                api_state = ApiState(engine, tokenizer, sampler,
+                                     lookup_decode=args.lookup_decode)
             try:
                 for _ in _completion_chunks(api_state, json.loads(msg.body)):
                     pass

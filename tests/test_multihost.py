@@ -101,8 +101,12 @@ def test_two_process_cluster_lookup_decode(tmp_path):
     port = _free_port()
     cluster = ["--nnodes", "2", "--coordinator", f"127.0.0.1:{port}"]
     root, t = _run(["generate", *base, *cluster, "--node-rank", "0"])
+    # --lookup-decode is part of the cluster config fingerprint (API mode
+    # needs flag parity), so the worker passes it too; the RUN header's
+    # draft length is still what the replay uses
     worker, _ = _run(["worker", "--model", mpath, "--tokenizer", tpath,
                       "--temperature", "0", "--buffer-float-type", "f32",
+                      "--lookup-decode", "5",
                       *cluster, "--node-rank", "1"])
     out_root, err_root = root.communicate(timeout=t)
     out_worker, err_worker = worker.communicate(timeout=t)
@@ -148,13 +152,18 @@ def _stop(proc) -> tuple[str, str]:
         return proc.communicate(timeout=10)
 
 
-def test_two_process_cluster_api_mode(tmp_path):
+@pytest.mark.parametrize("lookup", [0, 5])
+def test_two_process_cluster_api_mode(tmp_path, lookup):
     """api mode over a 2-process cluster: the worker replays each request
     from its broadcast JSON body; the completion must equal the
-    single-process server's."""
+    single-process server's. lookup=5 exercises speculative replay — both
+    processes must carry the same --lookup-decode (it is in the cluster
+    config fingerprint) and mine identical drafts from the replayed
+    request, keeping the verify widths in lock-step."""
     mpath, tpath = _fixture(tmp_path)
     body = {"messages": [{"role": "user", "content": "hi"}],
             "max_tokens": 5, "temperature": 0}
+    lk = ["--lookup-decode", str(lookup)] if lookup else []
 
     def run_api(extra, http_port):
         # f32 buffers: default q80 would give the tp=2 cluster lossy
@@ -162,7 +171,7 @@ def test_two_process_cluster_api_mode(tmp_path):
         # test_two_process_cluster_matches_single)
         return _run(["api", "--model", mpath, "--tokenizer", tpath,
                      "--temperature", "0", "--seed", "11",
-                     "--buffer-float-type", "f32",
+                     "--buffer-float-type", "f32", *lk,
                      "--port", str(http_port), "--host", "127.0.0.1", *extra])
 
     # single-process reference completion
@@ -180,7 +189,7 @@ def test_two_process_cluster_api_mode(tmp_path):
     root, _ = run_api([*cluster, "--node-rank", "0"], port2)
     worker, _ = _run(["worker", "--model", mpath, "--tokenizer", tpath,
                       "--temperature", "0", "--seed", "11",
-                      "--buffer-float-type", "f32",
+                      "--buffer-float-type", "f32", *lk,
                       *cluster, "--node-rank", "1"])
     try:
         got = _post_completion(port2, body)
