@@ -28,10 +28,11 @@ the kernel at ~475 GB/s of packed-byte throughput (v5e VPU ~3.8 Tops/s),
 and whole-model decode measures 409-472 GB/s effective — the kernel runs
 at its VPU design ceiling, not the 819 GB/s HBM ceiling. For PREFILL
 chunks (t=256, bf16 MXU feeds) the kernel also wins decisively: 7B
-2048-token prefill measures 5842 tok/s fused vs 2299 tok/s on the XLA
-dequant-einsum path (2.5x) — whole-model prefill sits at ~40% MFU because
-the in-kernel nibble unpack (VPU) serializes with the MXU contraction,
-the known headroom if the two ever overlap. Cutting ops/byte
+2048-token prefill measures 6317 tok/s fused vs 2299 tok/s on the XLA
+dequant-einsum path (2.7x) — the round-3 kernel measured 5771 tok/s with
+the nibble unpack (VPU) fully serialized against the MXU contraction;
+sub-tiling the td=256 tile (see _n_sub) overlaps the two for +9.5%
+whole-model (+41% on the w1/w3 matmul alone). Cutting ops/byte
 further means int8 MXU dots — measured and REJECTED: an int4-unpack ->
 int8 dot_general variant runs 4x slower at t=1 (82 vs 331 GB/s packed,
 tools/exp_int8_dot.py) because Mosaic has no efficient int8 gemv path;
@@ -90,7 +91,8 @@ def _f16_bits_to_f32(u: jnp.ndarray) -> jnp.ndarray:
 def _dequant_dot(x_lo, x_hi, xsum, pk_u8, s_raw,
                  *, out_dtype, scales_u16, mxu_bf16):
     """The kernel math on loaded blocks: dequantize a (TD, M) packed tile in
-    registers and contract with the pre-split activations."""
+    registers and contract with the pre-split activations. Activations must
+    already be in the contraction dtype (bf16 when mxu_bf16)."""
     pk = pk_u8.astype(jnp.int32)                         # (TD, M=16*nb)
     lo = (pk & 0xF).astype(jnp.float32)
     hi = (pk >> 4).astype(jnp.float32)
@@ -113,21 +115,62 @@ def _dequant_dot(x_lo, x_hi, xsum, pk_u8, s_raw,
     if mxu_bf16:
         # multi-token (prefill) chunks are MXU-bound: f32 feeds cap the MXU
         # at 1/4 of its bf16 rate (v5e 49 vs 197 TFLOP/s), so cast the
-        # dequantized tiles and activations down. 4-bit weight levels and
-        # bf16 engine activations fit bf16 exactly; only requested when the
-        # caller's out_dtype is bf16 (decode t=1 stays f32/VPU-bound)
+        # dequantized tiles down. 4-bit weight levels and bf16 engine
+        # activations fit bf16 exactly; only requested when the caller's
+        # out_dtype is bf16 (decode t=1 stays f32/VPU-bound)
         wl, wh = wl.astype(jnp.bfloat16), wh.astype(jnp.bfloat16)
-        x_lo, x_hi = x_lo.astype(jnp.bfloat16), x_hi.astype(jnp.bfloat16)
     acc = dot(x_lo, wl)                                  # (T, TD)
     acc += dot(x_hi, wh)
     acc += dot(xsum, s) * -8.0                           # fold every (nib-8) offset
     return acc.astype(out_dtype)
 
 
+def _n_sub(td: int, m: int, mxu_bf16: bool) -> int:
+    """Sub-tile count for the unpack/MXU interleave (prefill mode only).
+
+    Splitting the (td, m) packed tile into n_sub row sub-tiles and issuing
+    each sub-tile's dot right after its unpack lets the MXU chew on sub-tile
+    i while the VPU unpacks i+1. Measured on v5e at t=256
+    (tools/exp_unpack_overlap.py + the w2-shape probe):
+      * w1/w3 shape (d=11008, m=2048, td=256): n_sub=8 wins 1.41x
+        (n_sub=2: 1.37x, n_sub=4: 1.38x)
+      * w2 shape (d=4096, m=5504, td=256): n_sub=2 wins 2.26x
+        (36.6 vs 82.9 ms/call); n_sub=4 measured SLOWER than whole-tile
+        and n_sub=8 OOMs scoped VMEM (16.77M > 16M limit)
+      * attention-projection shape (d=4096, m=2048, td=1024): every
+        sub-tile variant flat or worse (0.89-0.98x) — whole-tile stays
+    so: sub-tile only the td=256 tile, 8-way when the packed tile is at
+    most 512 KB (m <= 2048, the measured-safe regime), else 2-way. Decode
+    (t=1) is VPU-bound with nothing to overlap, so f32 mode stays
+    whole-tile. 32-row sub-tiles satisfy the uint8 sublane tile."""
+    if not (mxu_bf16 and td == 256):
+        return 1
+    return 8 if td * m <= (1 << 19) else 2
+
+
+def _subtiled_write(x_lo, x_hi, xsum, load_packed, load_scales, out_ref,
+                    *, out_dtype, scales_u16, mxu_bf16):
+    """Run _dequant_dot per 1/n_sub row slice of the packed tile, writing
+    each output column slice as soon as its dot is issued. load_packed /
+    load_scales map a row slice -> loaded sub-block (ref slicing stays at
+    the call site because the expert kernel's refs carry a leading dim)."""
+    td = out_ref.shape[-1]
+    n_sub = _n_sub(td, x_lo.shape[-1], mxu_bf16)
+    if mxu_bf16:
+        x_lo, x_hi = x_lo.astype(jnp.bfloat16), x_hi.astype(jnp.bfloat16)
+    h = td // n_sub
+    for i in range(n_sub):
+        sl = slice(i * h, (i + 1) * h)
+        out_ref[:, sl] = _dequant_dot(
+            x_lo, x_hi, xsum, load_packed(sl), load_scales(sl),
+            out_dtype=out_dtype, scales_u16=scales_u16, mxu_bf16=mxu_bf16)
+
+
 def _kernel(x_lo_ref, x_hi_ref, xsum_ref, packed_ref, scales_ref, out_ref,
             *, nb, out_dtype, scales_u16, mxu_bf16):
-    out_ref[:] = _dequant_dot(
-        x_lo_ref[:], x_hi_ref[:], xsum_ref[:], packed_ref[:], scales_ref[:],
+    _subtiled_write(
+        x_lo_ref[:], x_hi_ref[:], xsum_ref[:],
+        lambda sl: packed_ref[sl, :], lambda sl: scales_ref[sl, :], out_ref,
         out_dtype=out_dtype, scales_u16=scales_u16, mxu_bf16=mxu_bf16)
 
 
@@ -135,8 +178,10 @@ def _expert_kernel(e_ref, x_lo_ref, x_hi_ref, xsum_ref, packed_ref,
                    scales_ref, out_ref, *, nb, out_dtype, scales_u16,
                    mxu_bf16):
     del e_ref  # consumed by the index maps (expert selection)
-    out_ref[:] = _dequant_dot(
-        x_lo_ref[:], x_hi_ref[:], xsum_ref[:], packed_ref[0], scales_ref[0],
+    _subtiled_write(
+        x_lo_ref[:], x_hi_ref[:], xsum_ref[:],
+        lambda sl: packed_ref[0, sl, :], lambda sl: scales_ref[0, sl, :],
+        out_ref,
         out_dtype=out_dtype, scales_u16=scales_u16, mxu_bf16=mxu_bf16)
 
 

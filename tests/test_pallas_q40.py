@@ -102,3 +102,74 @@ def test_supports_and_tiles():
     assert supports_pallas(qt)
     stacked = QuantizedTensor(qt.packed[None], qt.scales[None])  # (L, d, 16, nb)
     assert not supports_pallas(stacked)  # leading dims must be sliced first
+
+
+@pytest.mark.parametrize("d", [256, 1024])
+def test_subtiled_bf16_prefill_matches_whole_tile(rng, d, monkeypatch):
+    """The mxu_bf16 unpack/MXU interleave (t>=16, bf16 out, td=256 sub-tiled
+    8-way) must be a pure regrouping of output writes: each output element
+    still sees one full-N contraction, so forcing n_sub=1 on the same kernel
+    must reproduce the sub-tiled output to within 1 bf16 ulp (XLA's dot
+    blocks its f32 accumulation differently per output shape, so bitwise
+    equality is not guaranteed — but the math is the same contraction).
+    (A bf16-dequant einsum oracle is deliberately not the reference here:
+    the kernel's -8-offset fold amplifies bf16 rounding vs naively-rounded
+    (nib-8)*s weights — see the module docstring.)"""
+    from distributed_llama_tpu.ops import pallas_q40 as q
+
+    n, t = 1024, 32
+    qt = _qt(rng, d, n)
+    td = _tile_d(d, qt.packed.shape[1])
+    assert q._n_sub(td, qt.packed.shape[1], True) == (8 if td == 256 else 1)
+    x = jnp.asarray(rng.standard_normal((t, n), dtype=np.float32))
+    got = q40_matmul(x, qt, out_dtype=jnp.bfloat16, interpret=True)
+    assert got.dtype == jnp.bfloat16
+
+    monkeypatch.setattr(q, "_n_sub", lambda td_, m_, mxu: 1)
+    q40_matmul.clear_cache()
+    whole = q40_matmul(x, qt, out_dtype=jnp.bfloat16, interpret=True)
+    q40_matmul.clear_cache()  # drop the patched-trace cache entry
+    g, w = np.asarray(got, dtype=np.float32), np.asarray(whole, dtype=np.float32)
+    np.testing.assert_allclose(g, w, rtol=2 ** -7, atol=2 ** -7 * np.abs(w).max())
+
+    # loose sanity vs the exact f32 oracle (bf16 feeds: ~1% relative)
+    ref = jnp.einsum("tn,dn->td", x, dequantize_q40_jax(qt, dtype=jnp.float32))
+    scale = float(np.abs(np.asarray(ref)).max())
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(ref),
+        atol=0.03 * scale, rtol=0.03)
+
+
+def test_subtiled_expert_kernel_matches_whole_tile(rng, monkeypatch):
+    """The expert kernel's leading-dim ref slicing (packed_ref[0, sl, :])
+    must survive sub-tiling: a t>=16 bf16 expert matmul at a td=256 tile
+    runs n_sub=8, and forcing n_sub=1 must agree to 1 bf16 ulp (MoE
+    prefill's hot path — decode t=1 never sub-tiles)."""
+    from distributed_llama_tpu.ops import pallas_q40 as q
+    from distributed_llama_tpu.ops.pallas_q40 import q40_expert_matmul
+
+    n_e, d, n, t, e = 4, 256, 1024, 32, 2
+    qts = [_qt(rng, d, n) for _ in range(n_e)]
+    stack = QuantizedTensor(jnp.stack([qq.packed for qq in qts]),
+                            jnp.stack([qq.scales for qq in qts]))
+    assert q._n_sub(_tile_d(d, stack.packed.shape[2]),
+                    stack.packed.shape[2], True) == 8
+    x = jnp.asarray(rng.standard_normal((t, n), dtype=np.float32))
+    got = q40_expert_matmul(x, stack, jnp.int32(e),
+                            out_dtype=jnp.bfloat16, interpret=True)
+    assert got.dtype == jnp.bfloat16
+
+    monkeypatch.setattr(q, "_n_sub", lambda td_, m_, mxu: 1)
+    q40_expert_matmul.clear_cache()
+    whole = q40_expert_matmul(x, stack, jnp.int32(e),
+                              out_dtype=jnp.bfloat16, interpret=True)
+    q40_expert_matmul.clear_cache()
+    g = np.asarray(got, dtype=np.float32)
+    w = np.asarray(whole, dtype=np.float32)
+    np.testing.assert_allclose(g, w, rtol=2 ** -7, atol=2 ** -7 * np.abs(w).max())
+
+    # and the sub-tiled output still tracks the selected expert's oracle
+    ref = np.asarray(jnp.einsum("tn,dn->td", x,
+                                dequantize_q40_jax(qts[e], dtype=jnp.float32)))
+    scale = float(np.abs(ref).max())
+    np.testing.assert_allclose(g, ref, atol=0.03 * scale, rtol=0.03)

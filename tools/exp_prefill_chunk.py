@@ -13,6 +13,11 @@ Measured (v5e, 7B Q40, 2048-token prompt): 256-token fused chunks win by
 the XLA dequant path never catches up even with the whole prompt in one
 segment, and 256 is also the kernel's VMEM ceiling for its (t, m) f32
 activation blocks. The engine default stands confirmed.
+
+Re-measured (round 4) after the unpack/MXU sub-tile interleave landed in
+the kernel (ops/pallas_q40._n_sub): 128: 4650 tok/s, 256: 6317, 512: 3337,
+1024: 4056, 2048: 4461 — chunk 256 still the winner, now +9.5% whole-model
+over the round-3 kernel (6317 vs 5771).
 """
 
 from __future__ import annotations

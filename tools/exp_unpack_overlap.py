@@ -8,20 +8,27 @@ could let the MXU queue chew on sub-tile i while the VPU unpacks i+1 —
 IF Mosaic's scheduler lets the data-independent VPU work run ahead of an
 issued matmul.
 
-STATUS: NOT YET MEASURED — the tunneled TPU backend went unavailable when
-this was queued (end of round 3). Run when a chip is free:
+STATUS: MEASURED (round 4, v5e). FFN shape D=11008 (td=256):
+    current          36.10 ms/call   1.00x
+    td=128 n_sub=2   27.48           1.31x
+    td=128 n_sub=4   37.95           0.95x
+    td=256 n_sub=2   26.36           1.37x
+    td=256 n_sub=4   26.14           1.38x
+    td=256 n_sub=8   25.60           1.41x   <- WINNER, threaded through
+Attention-projection shape EXP_D=4096 (td=1024): every sub-tile variant
+flat or worse (0.89-0.98x), so _n_sub in ops/pallas_q40.py sub-tiles ONLY
+the td=256 tile. (ms/call includes the tunnel's ~17 ms amortized dispatch;
+the kernel-only delta is larger than 1.41x.) Run with:
 
-    PYTHONPATH=/root/repo python tools/exp_unpack_overlap.py
-
-Expected decision rule: if any (td, n_sub) beats the current kernel by
->10% at t=256, thread an n_sub parameter through pallas_q40._kernel for
-the mxu_bf16 (prefill) mode only; decode (t=1) stays VPU-bound and cannot
-benefit.
+    cd /root/repo && python tools/exp_unpack_overlap.py          # D=11008
+    EXP_D=4096 python tools/exp_unpack_overlap.py                # td=1024
+(do NOT override PYTHONPATH — the TPU plugin registers through it)
 """
 
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
@@ -35,7 +42,12 @@ sys.path.insert(0, ".")
 from distributed_llama_tpu.ops import pallas_q40 as q  # noqa: E402
 from distributed_llama_tpu.quants.jax_codec import QuantizedTensor  # noqa: E402
 
-D, N, T = 11008, 4096, 256
+# EXP_D=4096 covers the attention-projection shape whose _tile_d pick is
+# td=1024 (the FFN shape D=11008 can only tile at 128/256); EXP_D=4096 +
+# EXP_N=11008 covers the w2 shape (m=5504, the n_sub=2 VMEM-bound regime)
+D = int(os.environ.get("EXP_D", "11008"))
+N = int(os.environ.get("EXP_N", "4096"))
+T = 256
 NB = N // 32
 M = 16 * NB
 
@@ -107,13 +119,39 @@ def main():
         return run
 
     fl = 2 * T * D * N
-    variants = [("current", lambda v: q.q40_matmul(v, w, out_dtype=jnp.bfloat16))]
+
+    def whole_tile(v):
+        # the engine kernel sub-tiles since round 4 — pin the baseline to
+        # n_sub=1 so this experiment keeps measuring landed-vs-whole-tile
+        orig = q._n_sub
+        q._n_sub = lambda td, m, mxu: 1
+        try:
+            q.q40_matmul.clear_cache()
+            return q.q40_matmul(v, w, out_dtype=jnp.bfloat16)
+        finally:
+            q._n_sub = orig
+
+    def landed(v):
+        # clear q40_matmul's inner jit cache at trace time so this variant
+        # cannot reuse the whole-tile trace cached by the baseline above
+        q.q40_matmul.clear_cache()
+        return q.q40_matmul(v, w, out_dtype=jnp.bfloat16)
+
+    variants = [("whole-tile", whole_tile), ("landed", landed)]
     # tile sizes must divide D = 11008 = 2^8 * 43 exactly — a flooring
     # grid would silently skip rows and bias the comparison (td=512 would
     # cover only 97.7% of the output) — and both the tile and its
     # sub-slices must stay 32-row aligned (the uint8 sublane tile)
-    combos = ((128, 2), (128, 4), (256, 2), (256, 4), (256, 8), (2752, 2))
-    assert all(D % td == 0 and td % 32 == 0 and (td // ns) % 32 == 0
+    if D == 11008:
+        combos = ((128, 2), (128, 4), (256, 2), (256, 4), (256, 8))
+    elif N > 4096:  # w2 shape: m > 4096 bytes/row — n_sub=8 OOMs scoped VMEM
+        combos = ((256, 2), (256, 4))
+    else:  # D=4096: the engine's _tile_d picks 1024 here
+        combos = ((256, 8), (512, 8), (1024, 2), (1024, 4), (1024, 8))
+    # ... and the OUTPUT block's last dim (td) must itself be 128-aligned:
+    # D = 11008 = 2^8 * 43, so the only legal tile sizes are 128 and 256
+    # (td=2752 = 64*43 fails Mosaic's last-dim-divisible-by-128 check)
+    assert all(D % td == 0 and td % 128 == 0 and (td // ns) % 32 == 0
                for td, ns in combos), combos
     variants += [(f"td={td} n_sub={ns}",
                   lambda v, td=td, ns=ns: matmul_sub(v, w, ns, td))
@@ -131,19 +169,23 @@ def main():
             np.asarray(run(x))
             dt = (time.perf_counter() - t0) / 8
             best[name] = min(best.get(name, dt), dt)
-    base = best["current"]
+    base = best["whole-tile"]
     for name, _ in runs:
         dt = best[name]
         rel = base / dt
         print(f"{name}: {dt*1e3:.3f} ms/call, {fl/dt/1e12:.1f} TFLOP/s, "
-              f"{rel:.2f}x vs current")
+              f"{rel:.2f}x vs whole-tile")
     winner = min(best, key=best.get)
-    if winner != "current" and base / best[winner] > 1.10:
-        print(f"DECISION: {winner} beats current by >10% — thread n_sub "
-              "through pallas_q40._kernel's mxu_bf16 mode")
+    if winner == "landed" or best["landed"] <= best[winner] * 1.02:
+        print("DECISION: the landed _n_sub policy is (still) within 2% of "
+              "the best variant — keep it")
+    elif winner == "whole-tile":
+        print("DECISION: whole-tile now beats the landed sub-tiling — "
+              "re-measure and revisit _n_sub in ops/pallas_q40.py")
     else:
-        print("DECISION: no variant beats current by >10% — record the "
-              "negative result in ops/pallas_q40.py and keep the kernel")
+        print(f"DECISION: {winner} beats the landed policy by "
+              f"{best['landed'] / best[winner]:.2f}x — update _n_sub in "
+              "ops/pallas_q40.py to match")
 
 
 if __name__ == "__main__":

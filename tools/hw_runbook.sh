@@ -20,8 +20,17 @@ if ! timeout 120 python -c "import jax; print(jax.devices())"; then
 fi
 
 echo "== unpack/MXU overlap experiment =="
-PYTHONPATH=. timeout 1800 python tools/exp_unpack_overlap.py \
+# NOTE: do NOT override PYTHONPATH here — the TPU plugin registers via the
+# environment's existing PYTHONPATH (/root/.axon_site), and the script
+# sys.path-inserts the repo root itself. Three legs: FFN w1/w3 shape
+# (td=256 8-way), attention-projection shape (td=1024, stays whole-tile),
+# w2 shape (m=5504, the n_sub=2 VMEM-bound regime).
+timeout 1800 python tools/exp_unpack_overlap.py \
     2>&1 | tee tools/artifacts/overlap_$(date +%H%M).txt
+EXP_D=4096 timeout 1800 python tools/exp_unpack_overlap.py \
+    2>&1 | tee tools/artifacts/overlap_attn_$(date +%H%M).txt
+EXP_D=4096 EXP_N=11008 timeout 1800 python tools/exp_unpack_overlap.py \
+    2>&1 | tee tools/artifacts/overlap_w2_$(date +%H%M).txt
 
 echo "== full default bench =="
 timeout 10800 python bench.py \
