@@ -139,20 +139,36 @@ def _completion_chunks(state: ApiState, body: dict):
             logits = engine.step(np.asarray([[tok]], np.int32), engine.pos)
             history.append(tok)  # stepping tok wrote its K/V
 
-    # greedy requests can speculate: prompt-lookup drafts verified in one
-    # forward (exact greedy stream — runtime/speculative.py). Safe on
-    # multi-host clusters too: prefix reuse is off there, so every process
-    # replays the identical request from token 0 and mines identical
-    # drafts — same verify widths, collectives in lock-step (the
-    # --lookup-decode flag itself is in the cluster config fingerprint)
-    use_lookup = state.lookup_decode > 0 and sampler.temperature == 0.0
+    # requests can speculate: prompt-lookup drafts verified in one forward.
+    # Greedy requests stream the EXACT greedy tokens (argmax verify); at
+    # temperature > 0 the rejection-resampling mode keeps every emitted
+    # token distributed exactly as a host-sampler draw, but on a DERIVED
+    # numpy RNG — the token stream is not the plain path's xorshift stream
+    # (acceptance consumes a data-dependent number of uniforms, so coin
+    # parity is impossible by construction — runtime/speculative.py). Safe
+    # on multi-host clusters: prefix reuse is off there, so every process
+    # replays the identical request from token 0, mines identical drafts,
+    # and (sampled mode) derives the identical seed from the replicated
+    # sampler stream (Sampler.next_seed) — same verify widths, collectives
+    # in lock-step (the --lookup-decode flag itself is in the cluster
+    # config fingerprint)
+    use_lookup = state.lookup_decode > 0
     history = list(tokens)  # every prompt position is written by prefill
     try:
-        if use_lookup:
+        if use_lookup and sampler.temperature == 0.0:
             token_iter = engine.generate_lookup_stream(
                 suffix, n_gen, history=tokens,
                 draft_len=state.lookup_decode,
                 vocab_size=tokenizer.vocab_size)
+        elif use_lookup and sampler.temperature > 0.0:
+            token_iter = engine.generate_lookup_sampled_stream(
+                suffix, n_gen, history=tokens,
+                temperature=sampler.temperature, topp=sampler.topp,
+                seed=sampler.next_seed(),
+                draft_len=state.lookup_decode,
+                vocab_size=tokenizer.vocab_size)
+        # (a client-supplied NEGATIVE temperature falls through to the
+        # plain loop — served as before, never asserted on)
         else:
             token_iter = plain_tokens()
         for tok in token_iter:

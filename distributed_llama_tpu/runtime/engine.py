@@ -798,13 +798,47 @@ class Engine:
         accept RATE is content- and temperature-dependent (peaked
         distributions on repetitive text accept most drafts).
         `last_accept_stats` updates per forward like the greedy mode."""
+        stats = RunStats()
+        out: list[int] = []
+        for t in self.generate_lookup_sampled_stream(
+                prompt, max_tokens, temperature=temperature, topp=topp,
+                seed=seed, eos_id=eos_id, draft_len=draft_len,
+                max_ngram=max_ngram, vocab_size=vocab_size,
+                history=history, stats=stats):
+            out.append(t)
+            if on_token:
+                on_token(t)
+        return GenerationResult(out, stats)
+
+    def generate_lookup_sampled_stream(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        *,
+        temperature: float,
+        topp: float,
+        seed: int,
+        eos_id: int | set[int] | None = None,
+        draft_len: int = 7,
+        max_ngram: int = 3,
+        vocab_size: int | None = None,
+        history: list[int] | None = None,
+        stats: RunStats | None = None,
+    ) -> Iterator[int]:
+        """Token-iterator form of generate_lookup_sampled — the shape the
+        API server streams from (mirrors generate_lookup_stream's greedy
+        iterator; the K/V bookkeeping contract is identical, so a consumer
+        appends emitted tokens to its history as they arrive). The stream
+        is deterministic in (seed, logits, drafts): replicated multihost
+        processes that derive the same seed (Sampler.next_seed) draw the
+        same uniforms, accept the same widths, and keep their collectives
+        in lock-step."""
         from .speculative import accept_or_resample, draw, target_dist
 
         assert temperature > 0, "temperature 0 is the parity-exact greedy mode"
         spec_v = min(vocab_size or self.spec.vocab_size,
                      self.spec.vocab_size)
         rng = np.random.default_rng(seed)
-        stats = RunStats()
 
         def first(row: np.ndarray) -> int:
             return draw(target_dist(row, temperature, topp, spec_v),
@@ -829,15 +863,10 @@ class Engine:
             emitted.append(draw(p_k, rng.random()))
             return emitted
 
-        out: list[int] = []
-        for t in self._lookup_loop(prompt, max_tokens, eos_id,
-                                   draft_len=draft_len, max_ngram=max_ngram,
-                                   history=history, stats=stats,
-                                   first_fn=first, verify_fn=verify):
-            out.append(t)
-            if on_token:
-                on_token(t)
-        return GenerationResult(out, stats)
+        return self._lookup_loop(prompt, max_tokens, eos_id,
+                                 draft_len=draft_len, max_ngram=max_ngram,
+                                 history=history, stats=stats,
+                                 first_fn=first, verify_fn=verify)
 
     # -- batched generation (dp path) -------------------------------------
 

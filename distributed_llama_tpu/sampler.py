@@ -83,6 +83,19 @@ class Sampler:
     def set_seed(self, seed: int) -> None:
         self.rng_state = seed & ((1 << 64) - 1)
 
+    def next_seed(self) -> int:
+        """Advance the xorshift stream one step and return the new state as
+        a 64-bit seed for derived per-request RNGs (sampled speculation,
+        runtime/engine.generate_lookup_sampled_stream). Replicated
+        processes holding identical sampler state derive identical seeds —
+        the invariant the API server's multihost lock-step rests on — while
+        consecutive calls yield fresh seeds (two identical back-to-back
+        sampled-speculation requests must not produce identical text, just
+        like two plain sampled requests don't)."""
+        s, _ = xorshift_f32(self.rng_state)
+        self.rng_state = s
+        return s
+
     def _coin(self) -> float:
         self._rng_state, v = xorshift_f32(self._rng_state)
         return v

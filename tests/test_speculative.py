@@ -214,7 +214,8 @@ def test_lookup_eos_truncates_and_continues():
 
 def test_api_lookup_decode_matches_plain(tmp_path):
     """API server: greedy requests with lookup_decode speculate (fewer
-    forwards) with byte-identical responses; sampled requests fall back."""
+    forwards) with byte-identical responses; sampled requests speculate
+    via rejection resampling (distribution-exact, seed-deterministic)."""
     from distributed_llama_tpu.apps import dllama
     from distributed_llama_tpu.apps.api_server import (
         ApiState, _completion_chunks)
@@ -239,12 +240,26 @@ def test_api_lookup_decode_matches_plain(tmp_path):
     fwd, n = st.engine.last_accept_stats
     assert n >= fwd  # speculation engaged (>= 1 token per forward)
 
-    # sampled request: must NOT take the lookup path (distribution-exact)
+    # sampled request: takes the rejection-resampling lookup path — the
+    # token stream is a DERIVED numpy RNG's, not the plain path's xorshift
+    # stream (coin parity is impossible by construction), so the contract
+    # is seed-determinism, not byte parity with the plain path. The
+    # distribution-exactness of the mode itself is pinned by
+    # test_lookup_sampled_marginals_match_plain_sampling.
     body_s = {"messages": [{"role": "user", "content": "abab"}],
-              "max_tokens": 4, "temperature": 0.8, "seed": 11}
-    want_s = list(_completion_chunks(build_state(0), body_s))
-    got_s = list(_completion_chunks(build_state(5), body_s))
-    assert got_s == want_s
+              "max_tokens": 6, "temperature": 0.8, "seed": 11}
+    st_a, st_b = build_state(5), build_state(5)
+    before = st_a.sampler.rng_state
+    got_a = list(_completion_chunks(st_a, body_s))
+    got_b = list(_completion_chunks(st_b, body_s))
+    assert got_a == got_b  # identical server state + seed -> identical text
+    fwd_s, n_s = st_a.engine.last_accept_stats
+    assert n_s >= fwd_s  # the sampled stream really speculated
+    # ... and the per-request seed restore still holds: with an explicit
+    # request seed, the shared sampler stream must come back exactly where
+    # it was (next_seed's advance happened on the request-seeded state and
+    # is rolled back with it)
+    assert st_a.sampler.rng_state == before
 
 
 def test_chat_lookup_decode_matches_plain(tmp_path, capsys, monkeypatch):
