@@ -345,6 +345,43 @@ def _lookup_row(engine, repeats: int) -> dict:
     return row
 
 
+def _batch_row(params, spec: ModelSpec, repeats: int, b: int = 8) -> dict:
+    """Batched decode aggregate throughput on ONE chip: decode is
+    weight-read-bound at batch=1, so b rows amortize the same weight read
+    across b tokens — the single-chip serving-throughput headline the
+    batched API endpoint rides on. Measured through the ON-DEVICE batched
+    loop (generate_batch_device — one dispatch for the whole run): the
+    host-loop batch path pays the tunnel's ~140 ms per step on this
+    platform, which would measure the tunnel, not the amortization."""
+    import gc
+    import time
+
+    eng = Engine(spec, params, compute_dtype=jnp.bfloat16,
+                 cache_dtype=jnp.bfloat16, max_seq_len=512, batch=b)
+    n = 96
+    prompts = [[1, 17 + i, 93, 5 + i] for i in range(b)]
+    best = None
+    for i in range(repeats + 1):  # run 0 compiles — excluded
+        eng.reset()
+        t0 = time.perf_counter()
+        outs = eng.generate_batch_device(
+            prompts, n, temperature=0.8, topp=0.9, seed=9)
+        dt = time.perf_counter() - t0
+        if i > 0:
+            best = dt if best is None else min(best, dt)
+    toks = sum(len(o) for o in outs)
+    agg_tok_s = toks / best
+    del eng
+    gc.collect()
+    return {
+        "metric": f"llama2_7b_q40_batch{b}_device_decode_agg_tok_per_s_1chip",
+        "value": round(agg_tok_s, 1), "unit": "tok/s",
+        "vs_baseline": None,
+        "ms_per_step": round(best / (toks / b) * 1e3, 3),
+        "batch": b,
+    }
+
+
 def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
     """Extra measured rows for the default 7b run: prefill throughput,
     8k-fill long-context decode (bf16 and fp8 caches — the documented fp8
@@ -373,6 +410,9 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
         gc.collect()
 
     emit(_lookup_row(engine, repeats))
+    # batched decode needs its own engine (batch is a build-time shape);
+    # the 7b weights are shared, the extra KV cache is 512-seq x 8 rows
+    emit(_batch_row(params, spec, repeats))
 
 
 def _moe_row(repeats: int) -> dict:

@@ -51,7 +51,7 @@ def build_chat_prompt(messages: list[dict]) -> str:
 
 class ApiState:
     def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama",
-                 lookup_decode: int = 0):
+                 lookup_decode: int = 0, serve_batch: int = 0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -62,6 +62,31 @@ class ApiState:
         # greedy requests draft+verify up to this many tokens per forward
         # (prompt-lookup speculation, runtime/speculative.py); 0 = off
         self.lookup_decode = lookup_decode
+        # POST /v1/batch/completions serves up to this many prompts per
+        # request through one batched engine (0 = endpoint off). Decode is
+        # weight-read-bound, so b rows amortize one weight read — the
+        # single-chip serving-throughput lever (bench.py _batch_row).
+        self.serve_batch = serve_batch
+        self._batch_engine = None
+
+    def batch_engine(self):
+        """The batch=serve_batch engine, built on first use. It SHARES the
+        single engine's param device buffers (weights are never duplicated;
+        only the extra b-row KV cache is new memory) and mirrors its
+        dtypes/seq_len. Single-device only — serve() refuses --serve-batch
+        on meshes/clusters at startup."""
+        if self._batch_engine is None:
+            from ..runtime.engine import Engine
+
+            e = self.engine
+            self._batch_engine = Engine(
+                e.spec, e.params, batch=self.serve_batch,
+                max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
+                cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
+                pallas_interpret=e.pallas_interpret,
+                activation_q80=e.activation_q80,
+                prefill_chunk=e.prefill_chunk)
+        return self._batch_engine
 
 
 def _completion_chunks(state: ApiState, body: dict):
@@ -199,6 +224,107 @@ def _completion_chunks(state: ApiState, body: dict):
                     "completion_tokens": emitted})
 
 
+def _batch_completion_chunks(state: ApiState, body: dict):
+    """POST /v1/batch/completions generator: up to serve_batch prompts
+    decoded in ONE batched engine (net-new vs the reference's batch=1
+    server — decode is weight-read-bound, so b rows amortize one weight
+    read; bench.py's _batch_row measures the aggregate-throughput win).
+
+    Yields ("piece", (row, piece)) events then one ("done", {...}) with
+    per-row finish/usage. Per-request temperature/seed apply to the whole
+    batch through the shared reference-parity sampler stream (coins drawn
+    in row order — Sampler.sample_batch); rows are independent sequences.
+    No prefix reuse here: the batch cache is reset per request (the
+    single-request endpoint keeps that feature)."""
+    engine = state.batch_engine()
+    tokenizer, sampler = state.tokenizer, state.sampler
+
+    if "prompts" in body:
+        texts = body["prompts"]
+        raw = True
+    else:
+        texts = [build_chat_prompt(m) for m in body.get("messages_list", [])]
+        raw = False
+    b = len(texts)
+    if not (1 <= b <= state.serve_batch):
+        raise PromptTooLong(
+            f"batch size {b} outside 1..{state.serve_batch} "
+            "(server started with --serve-batch "
+            f"{state.serve_batch})")
+    max_tokens = int(body.get("max_tokens", 64))
+    stops = body.get("stop") or []
+    if isinstance(stops, str):
+        stops = [stops]
+
+    rows = [tokenizer.encode(t) for t in texts]  # add_bos default, like the single path
+    limit = engine.seq_len - 1
+    for i, r in enumerate(rows):
+        if len(r) >= limit:
+            raise PromptTooLong(
+                f"prompt {i}: {len(r)} tokens >= context {limit}")
+    # budget: MAX over rows of the per-row cache headroom (rows share the
+    # step loop; a longer-prompt row hitting seq_len retires only itself —
+    # the engine's per-row pos guard — so one long prompt must not cap the
+    # shorter rows' output)
+    n_gen = min(max_tokens, max(limit - len(r) for r in rows))
+    n_prompt_toks = sum(len(r) for r in rows)  # before padding rows join
+
+    saved_temp = sampler.temperature
+    saved_rng_state = None
+    if body.get("temperature") is not None:
+        sampler.set_temp(float(body["temperature"]))
+    if body.get("seed") is not None:
+        saved_rng_state = sampler.rng_state
+        sampler.set_seed(int(body["seed"]))
+
+    markers = () if raw else CHAT_EOS_MARKERS
+    tail_len = max([len(m) for m in markers]
+                   + [len(s) for s in stops] + [1]) + 16
+    prev = [r[-1] for r in rows]
+    tails = [""] * b
+    emitted = [0] * b
+    finish = ["length"] * b
+    # the engine's batch is a build-time shape: pad sub-batch requests with
+    # pre-retired rows (flagged before the first step, so they never sample
+    # — no coins leave the shared stream — and never emit)
+    n_pad = engine.batch - b
+    rows = rows + [[rows[0][0]]] * n_pad
+    stop_flags = np.zeros(engine.batch, bool)
+    stop_flags[b:] = True
+    engine.reset()
+    try:
+        for step in engine.generate_batch_stream(
+                rows, n_gen, sampler, stop_flags=stop_flags):
+            for i, tok in enumerate(step):
+                if tok is None or stop_flags[i]:
+                    continue
+                if tok == tokenizer.eos_id:
+                    finish[i] = "stop"
+                    stop_flags[i] = True
+                    continue
+                piece = tokenizer.decode_piece(prev[i], tok).decode(
+                    "utf-8", errors="replace")
+                prev[i] = tok
+                tails[i] = (tails[i] + piece)[-tail_len:]
+                if (any(m in tails[i] for m in markers)
+                        or (stops and any(s in tails[i] for s in stops))):
+                    finish[i] = "stop"
+                    stop_flags[i] = True
+                    continue
+                emitted[i] += 1
+                yield ("piece", (i, piece))
+    finally:
+        sampler.set_temp(saved_temp)
+        if saved_rng_state is not None:
+            sampler.rng_state = saved_rng_state
+        engine.reset()  # the batch cache holds nothing reusable
+    yield ("done", {
+        "finish_reasons": finish,
+        "prompt_tokens": n_prompt_toks,
+        "completion_tokens": sum(emitted),
+    })
+
+
 def load_server_session(state: ApiState, path: str) -> None:
     """Restore a previous server process's prefix cache + token history
     (Engine.load_session — refuses a mismatched model via the content
@@ -233,6 +359,27 @@ def save_server_session(state: ApiState, path: str) -> bool:
     return True
 
 
+def _chunk_env(rid: str, created: int, model: str, index: int,
+               delta: dict, finish_reason) -> dict:
+    """One SSE chat.completion.chunk envelope (shared by the single- and
+    batch-request streams; only the choice index differs between them)."""
+    return {"id": rid, "object": "chat.completion.chunk", "created": created,
+            "model": model,
+            "choices": [{"index": index, "delta": delta,
+                         "finish_reason": finish_reason}]}
+
+
+def _completion_env(rid: str, created: int, model: str, choices: list,
+                    prompt_tokens: int, completion_tokens: int) -> dict:
+    """The non-streamed chat.completion envelope + usage
+    (ref: types.hpp:10-91)."""
+    return {"id": rid, "object": "chat.completion", "created": created,
+            "model": model, "choices": choices,
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": completion_tokens,
+                      "total_tokens": prompt_tokens + completion_tokens}}
+
+
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -248,6 +395,22 @@ def make_handler(state: ApiState):
             self.end_headers()
             self.wfile.write(data)
 
+        # SSE chunked streaming (ref: dllama-api.cpp:125-145,183-200)
+        def _sse_start(self) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+        def _sse(self, obj: dict) -> None:
+            self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+            self.wfile.flush()
+
+        def _sse_done(self) -> None:
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+
         def do_GET(self):
             if self.path == "/v1/models":
                 # ref: dllama-api.cpp:316-322
@@ -260,7 +423,8 @@ def make_handler(state: ApiState):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/v1/chat/completions":
+            if self.path not in ("/v1/chat/completions",
+                                 "/v1/batch/completions"):
                 self._json(404, {"error": "not found"})
                 return
             try:
@@ -269,7 +433,67 @@ def make_handler(state: ApiState):
             except (ValueError, json.JSONDecodeError):
                 self._json(400, {"error": "bad request"})
                 return
+            if self.path == "/v1/batch/completions":
+                self._batch_post(body)
+            else:
+                self._chat_post(body)
 
+        def _batch_post(self, body: dict) -> None:
+            """POST /v1/batch/completions — up to serve_batch prompts in one
+            batched decode. Response mirrors the chat shape with one choice
+            per row (index = row); SSE chunks tag their row via `index`."""
+            if state.serve_batch <= 0:
+                self._json(404, {
+                    "error": "batch endpoint off (start with --serve-batch N)"})
+                return
+            rid = f"batchcmpl-{int(time.time()*1000):x}"
+            created = int(time.time())
+            stream = bool(body.get("stream", False))
+            gen = _batch_completion_chunks(state, body)
+            try:
+                first = next(gen)
+            except PromptTooLong as e:
+                self._json(400, {"error": str(e)})
+                return
+
+            def events():
+                yield first
+                yield from gen
+
+            if stream:
+                self._sse_start()
+                usage = None
+                for kind, payload in events():
+                    if kind == "piece":
+                        i, piece = payload
+                        self._sse(_chunk_env(rid, created, state.model_name,
+                                             i, {"content": piece}, None))
+                    else:
+                        usage = payload
+                for i, fr in enumerate(usage["finish_reasons"]):
+                    self._sse(_chunk_env(rid, created, state.model_name,
+                                         i, {}, fr))
+                self._sse_done()
+                return
+
+            texts: dict[int, str] = {}
+            usage = None
+            for kind, payload in events():
+                if kind == "piece":
+                    i, piece = payload
+                    texts[i] = texts.get(i, "") + piece
+                else:
+                    usage = payload
+            self._json(200, _completion_env(
+                rid, created, state.model_name,
+                [{"index": i,
+                  "message": {"role": "assistant",
+                              "content": texts.get(i, "")},
+                  "finish_reason": fr}
+                 for i, fr in enumerate(usage["finish_reasons"])],
+                usage["prompt_tokens"], usage["completion_tokens"]))
+
+        def _chat_post(self, body: dict) -> None:
             rid = f"chatcmpl-{int(time.time()*1000):x}"
             created = int(time.time())
             stream = bool(body.get("stream", False))
@@ -304,36 +528,21 @@ def make_handler(state: ApiState):
                         pass
 
             if stream:
-                # SSE chunked streaming (ref: dllama-api.cpp:125-145,183-200)
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Connection", "close")
-                self.end_headers()
-
-                def sse(obj):
-                    self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
-                    self.wfile.flush()
-
+                self._sse_start()
                 usage = None
                 try:
                     for kind, payload in events():
                         if kind == "piece":
-                            sse({"id": rid, "object": "chat.completion.chunk",
-                                 "created": created, "model": state.model_name,
-                                 "choices": [{"index": 0,
-                                              "delta": {"content": payload},
-                                              "finish_reason": None}]})
+                            self._sse(_chunk_env(
+                                rid, created, state.model_name, 0,
+                                {"content": payload}, None))
                         else:
                             usage = payload
                 finally:
                     drain()
-                sse({"id": rid, "object": "chat.completion.chunk",
-                     "created": created, "model": state.model_name,
-                     "choices": [{"index": 0, "delta": {},
-                                  "finish_reason": usage["finish_reason"]}]})
-                self.wfile.write(b"data: [DONE]\n\n")
-                self.wfile.flush()
+                self._sse(_chunk_env(rid, created, state.model_name, 0, {},
+                                     usage["finish_reason"]))
+                self._sse_done()
                 return
 
             text = ""
@@ -346,18 +555,12 @@ def make_handler(state: ApiState):
                         usage = payload
             finally:
                 drain()
-            # OpenAI-shaped response + usage (ref: types.hpp:10-91)
-            self._json(200, {
-                "id": rid, "object": "chat.completion", "created": created,
-                "model": state.model_name,
-                "choices": [{"index": 0,
-                             "message": {"role": "assistant", "content": text},
-                             "finish_reason": usage["finish_reason"]}],
-                "usage": {
-                    "prompt_tokens": usage["prompt_tokens"],
-                    "completion_tokens": usage["completion_tokens"],
-                    "total_tokens": usage["prompt_tokens"] + usage["completion_tokens"],
-                }})
+            self._json(200, _completion_env(
+                rid, created, state.model_name,
+                [{"index": 0,
+                  "message": {"role": "assistant", "content": text},
+                  "finish_reason": usage["finish_reason"]}],
+                usage["prompt_tokens"], usage["completion_tokens"]))
 
     return Handler
 
@@ -378,9 +581,21 @@ def serve(args) -> None:
         # save runs for service deployments too.
         signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
+    serve_batch = getattr(args, "serve_batch", 0)
+    if serve_batch:
+        # the batch engine is single-process/single-device by design: a
+        # mesh needs sharded-batch plumbing and a cluster needs request
+        # replay for b-row steps — loud error beats a silently ignored flag
+        if getattr(args, "nnodes", 1) > 1 or jax.process_count() > 1:
+            sys.exit("error: --serve-batch does not compose with --nnodes")
+        if max(getattr(args, k, 1) for k in ("tp", "dp", "sp", "ep", "pp")) > 1:
+            sys.exit("error: --serve-batch needs a single-device engine "
+                     "(no --tp/--dp/--sp/--ep/--pp)")
+
     engine, tokenizer, sampler = build_engine(args)
     state = ApiState(engine, tokenizer, sampler,
-                     lookup_decode=getattr(args, "lookup_decode", 0))
+                     lookup_decode=getattr(args, "lookup_decode", 0),
+                     serve_batch=serve_batch)
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "

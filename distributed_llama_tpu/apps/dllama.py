@@ -122,6 +122,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "rejection-style, distribution-exact vs the host "
                         "sampler (different RNG stream). Net-new: the "
                         "reference is strictly 1 token/forward")
+    p.add_argument("--serve-batch", type=int, default=0, metavar="B",
+                   help="api mode: enable POST /v1/batch/completions, up "
+                        "to B prompts decoded in one batched engine (decode "
+                        "is weight-read-bound — B rows amortize one weight "
+                        "read per step for near-Bx aggregate tok/s; only "
+                        "the extra B-row KV cache is new memory). Single-"
+                        "process, single-device engines only. Net-new: the "
+                        "reference serves batch=1")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,

@@ -281,3 +281,93 @@ def test_cli_profile_flag(tmp_path, rng, capsys):
     assert "profiler trace written" in out
     found = [f for _, _, fs in os.walk(pdir) for f in fs]
     assert any(f.endswith((".pb", ".json.gz", ".xplane.pb")) for f in found), found
+
+
+@pytest.fixture
+def api_batch_server(tmp_path, rng):
+    mpath, tpath = _fixture(tmp_path, rng)
+    # f32: the batched step paths ("bpre"/"bvec") contain a bf16 dot
+    # XLA's CPU thunks cannot execute (real target is TPU; the non-batch
+    # API fixture keeps the bf16 default)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=3)
+    from http.server import HTTPServer
+    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address, state
+    server.shutdown()
+
+
+def test_api_batch_completions_greedy_matches_singles(api_batch_server,
+                                                      tmp_path, rng):
+    """POST /v1/batch/completions: each row's greedy completion must be
+    byte-identical to a fresh single-request server answering that prompt
+    alone (ragged lengths — right-padded batch prefill per-row parity)."""
+    (host, port), state = api_batch_server
+    msgs = [[{"role": "user", "content": c}] for c in ("ab", "abab x", "b")]
+
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"messages_list": msgs, "max_tokens": 5, "temperature": 0}
+    conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["object"] == "chat.completion"
+    assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+
+    from distributed_llama_tpu.apps.api_server import _completion_chunks
+    for i, m in enumerate(msgs):
+        st = ApiState(state.engine, state.tokenizer, state.sampler)
+        st.engine.reset()
+        st.cached_tokens = []
+        single = "".join(
+            p for kind, p in _completion_chunks(
+                st, {"messages": m, "max_tokens": 5, "temperature": 0})
+            if kind == "piece")
+        assert body["choices"][i]["message"]["content"] == single, i
+    state.engine.reset()
+    state.cached_tokens = []
+
+
+def test_api_batch_completions_streaming_and_validation(api_batch_server):
+    """SSE chunks carry per-row indices; oversized batches 400 cleanly."""
+    (host, port), state = api_batch_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"messages_list": [[{"role": "user", "content": "ab"}]] * 2,
+           "max_tokens": 3, "temperature": 0, "stream": True}
+    conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert {p["choices"][0]["index"] for p in parsed} == {0, 1}
+    finals = [p for p in parsed if p["choices"][0]["finish_reason"]]
+    assert len(finals) == 2
+
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"messages_list": [[{"role": "user", "content": "x"}]] * 4,
+           "max_tokens": 2, "temperature": 0}
+    conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 400
+
+
+def test_api_batch_endpoint_off_by_default(api_server):
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("POST", "/v1/batch/completions",
+                 json.dumps({"prompts": ["x"]}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 404

@@ -350,3 +350,45 @@ def test_generate_batch_stops_at_context_limit():
     outs = eng_b.generate_batch([long_p, [1, 2]], max_tokens=10, sampler=greedy)
     assert outs[0] == ref
     assert len(outs[1]) == 10  # short row unaffected by the exhausted one
+
+
+def test_generate_batch_stream_stop_flags_retire_rows():
+    """generate_batch_stream: collecting the stream equals generate_batch
+    (it IS generate_batch's engine), and a caller-set stop_flags[i]
+    retires row i between steps — the API server's stop-sequence scan
+    runs on decoded text the engine cannot see."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4)
+    host, _ = dense_weights(spec, seed=14)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    prompts = [[1, 5, 9], [2, 7], [4]]
+
+    def greedy():
+        return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1,
+                       backend="python")
+
+    eng = Engine(spec, params, batch=3, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    want = eng.generate_batch(prompts, max_tokens=6, sampler=greedy())
+
+    eng.reset()
+    got = [[] for _ in prompts]
+    for step in eng.generate_batch_stream(prompts, 6, greedy()):
+        for i, t in enumerate(step):
+            if t is not None:
+                got[i].append(t)
+    assert got == want
+
+    # retire row 1 after its second token: rows 0/2 must be unaffected
+    # (greedy rows are independent; the sampler draws no coins at temp 0)
+    eng.reset()
+    flags = np.zeros(3, bool)
+    got2 = [[] for _ in prompts]
+    for step in eng.generate_batch_stream(prompts, 6, greedy(),
+                                          stop_flags=flags):
+        for i, t in enumerate(step):
+            if t is not None:
+                got2[i].append(t)
+        if len(got2[1]) >= 2:
+            flags[1] = True
+    assert got2[0] == want[0] and got2[2] == want[2]
+    assert got2[1] == want[1][:2]
