@@ -91,16 +91,42 @@ def sample_token(logits: jnp.ndarray, state: jnp.ndarray,
     # would wrap `last` negative below; keep the (first) argmax then —
     # the same fallback as the host Sampler and the native twin
     keep = jnp.where(keep.any(), keep, jnp.arange(n) == jnp.argmax(probs))
-    # descending stable sort of candidates; non-candidates sink to the tail
-    # (key -1 < 0 <= any candidate prob) and contribute 0 to the cdf
+    # non-candidates carry key -1 < 0 <= any candidate prob, so they sink
+    # to the tail of any descending order and contribute 0 to the cdf
     key = jnp.where(keep, probs, -1.0)
-    order = jnp.argsort(-key, stable=True)
-    p_sorted = jnp.where(key[order] >= 0, probs[order], 0.0)
-    cum = jnp.cumsum(p_sorted)
-    over = cum > jnp.float32(topp)
     n_cand = jnp.sum(keep) - 1  # last candidate position, if none exceed topp
-    last = jnp.where(over.any(), jnp.argmax(over), n_cand)
-    total = cum[last]
-    r = coin * total
-    idx = jnp.minimum(jnp.searchsorted(cum, r, side="right"), last)
-    return order[idx].astype(jnp.int32), state
+
+    def _pick(order_p: jnp.ndarray, order_i: jnp.ndarray) -> jnp.ndarray:
+        """Truncate a descending candidate order at cum > topp and draw —
+        the shared tail of both the fast and the full path."""
+        p_sorted = jnp.where(order_p >= 0, order_p, 0.0)
+        cum = jnp.cumsum(p_sorted)
+        over = cum > jnp.float32(topp)
+        last = jnp.where(over.any(), jnp.argmax(over),
+                         jnp.minimum(n_cand, order_p.shape[0] - 1))
+        total = cum[last]
+        r = coin * total
+        idx = jnp.minimum(jnp.searchsorted(cum, r, side="right"), last)
+        return order_i[idx].astype(jnp.int32)
+
+    def _full(_) -> jnp.ndarray:
+        order = jnp.argsort(-key, stable=True)
+        return _pick(key[order], order)
+
+    # FAST PATH: a full (vocab,) argsort per token is the sampled-decode
+    # hot-path cost (measured ~1 ms/row/step at 32k vocab — ~8 ms of a
+    # 31 ms batch-8 step). The nucleus almost always lives in the top few
+    # hundred probs, so take an exact top-k window and use it whenever the
+    # truncation provably lands inside (cum > topp within the window, or
+    # fewer than k candidates exist); otherwise lax.cond runs the full
+    # sort. Tie order matches: lax.top_k breaks value ties by lower index,
+    # exactly like the stable descending argsort — token streams are
+    # IDENTICAL to the full path either way.
+    k = 512
+    if n <= 2 * k:
+        return _full(None), state
+    topv, topi = lax.top_k(key, k)
+    in_window = (jnp.cumsum(jnp.maximum(topv, 0.0)) > jnp.float32(topp)
+                 ).any() | (n_cand < k)
+    tok = lax.cond(in_window, lambda _: _pick(topv, topi), _full, None)
+    return tok, state
