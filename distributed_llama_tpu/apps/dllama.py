@@ -464,6 +464,11 @@ def _print_benchmark(args, engine, res, trace_dir=None) -> None:
         from ..parallel import multihost as mh
         mh.send_xfer_bench()  # workers join the collective microbench
     t_ms = engine.measure_transfer_ms()
+    # the first stats step is the whole prefill: its fallback T follows the
+    # schedule prefill actually ran (GPipe ppermute hops on pp meshes —
+    # engine.measure_prefill_transfer_ms), not the per-token decode model
+    n_prompt = max(engine.pos - (len(res.tokens) - 1), 1)
+    t_pre_ms = engine.measure_prefill_transfer_ms(n_prompt)
     t_steps: list[float] = []
     if trace_dir:
         from ..runtime.netstats import per_step_op_ms
@@ -483,7 +488,8 @@ def _print_benchmark(args, engine, res, trace_dir=None) -> None:
             print(f"⏩ trace module count mismatch (decode {len(dec_t)} vs "
                   f"{n_dec} steps); using the microbench T estimate")
     for i, s in enumerate(res.stats.steps):
-        tv = t_steps[i] if i < len(t_steps) else t_ms
+        tv = (t_steps[i] if i < len(t_steps)
+              else (t_pre_ms if i == 0 else t_ms))
         print(f"🔶 G {s.generation_ms:7.2f} ms I {s.device_ms:7.2f} ms "
               f"T {tv:6.2f} ms H {s.host_ms:5.2f} ms "
               f"S {wire.sent_kb_per_token:7.1f} kB")

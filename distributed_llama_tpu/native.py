@@ -60,6 +60,11 @@ def _load():
         lib.dllama_sampler_sample.restype = ctypes.c_int32
         lib.dllama_sampler_sample.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+        if hasattr(lib, "dllama_rng_fill_f32"):  # older .so builds lack it
+            lib.dllama_rng_fill_f32.restype = ctypes.c_uint64
+            lib.dllama_rng_fill_f32.argtypes = [
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64]
         _lib = lib
         return lib
     return None
@@ -67,6 +72,21 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def rng_fill_f32(state: int, n: int) -> tuple[int, np.ndarray]:
+    """n sequential xorshift* f32 draws (raw <0,1) stream, no scaling) as a
+    float32 array, plus the advanced state — the bulk form of
+    utils.rng.xorshift_f32 for golden-fixture weight generation
+    (tests/test_reference_golden.py seeds ~200M weights this way)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "dllama_rng_fill_f32"):
+        raise RuntimeError("native library not built (make -C native)")
+    out = np.empty(n, np.float32)
+    new_state = lib.dllama_rng_fill_f32(
+        state & ((1 << 64) - 1),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+    return int(new_state), out
 
 
 class NativeTokenizer:

@@ -61,7 +61,8 @@ def coin_f32(state: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def sample_token(logits: jnp.ndarray, state: jnp.ndarray,
-                 temperature: float, topp: float
+                 temperature: float, topp: float,
+                 _force_full: bool = False
                  ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sample one token id from (vocab,) logits; returns (token i32, state').
 
@@ -123,7 +124,7 @@ def sample_token(logits: jnp.ndarray, state: jnp.ndarray,
     # exactly like the stable descending argsort — token streams are
     # IDENTICAL to the full path either way.
     k = 512
-    if n <= 2 * k:
+    if _force_full or n <= 2 * k:
         return _full(None), state
     topv, topi = lax.top_k(key, k)
     in_window = (jnp.cumsum(jnp.maximum(topv, 0.0)) > jnp.float32(topp)

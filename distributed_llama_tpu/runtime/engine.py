@@ -64,6 +64,11 @@ class Engine:
         model_fingerprint: int = 0,  # content hash of the weights the
         # session fingerprint folds in (io.model_file.content_fingerprint);
         # 0 = unknown (in-memory params) — such sessions only check shapes
+        force_mesh_kernels: bool = False,  # engage the shard_map kernel
+        # path even on a 1-device mesh: the Pallas kernels then compile and
+        # run INSIDE manual regions on whatever silicon is present — the
+        # single-chip proof of the multi-chip kernel path (VERDICT r4 #1;
+        # bench.py's shardmap variant row)
     ):
         self.mesh = mesh
         self.batch = batch
@@ -118,7 +123,8 @@ class Engine:
         # (parallel/tp_q80.py): Q40 weights are marked TpRowWeight/TpColWeight
         # and attention shards over (dp, kv-heads). The col partial-sum
         # reduce is exact unless q80 collectives are on.
-        mesh_kernels = use_pallas and mesh is not None and mesh.size > 1
+        mesh_kernels = use_pallas and mesh is not None and (
+            mesh.size > 1 or force_mesh_kernels)
         self.tp_reduce = "q80" if self.q80_collectives else "exact"
         if mesh_kernels:
             self._tp_mesh = mesh
@@ -370,34 +376,85 @@ class Engine:
             batch=self.batch)
 
     def measure_transfer_ms(self) -> float:
-        """Measured per-token transfer estimate: times dim-sized all-reduces
-        on the mesh and scales by the per-layer reduce count (the reference's
-        T column, measured not modeled). Mirrors the collective structure
+        """Measured per-token DECODE transfer estimate: times activation-
+        sized collectives on the mesh and scales by the exact per-token
+        collective count of the decode schedule (the reference's T column,
+        measured not modeled). Mirrors the collective structure
         netstats.estimate_decode_wire models: per-layer tp reduces, plus the
-        single (ep, tp)-group MoE reduce when experts are ep-placed."""
+        single (ep, tp)-group MoE reduce when experts are ep-placed, plus —
+        for pp meshes — the all-stages scheme's per-stage live broadcast
+        (pp psums over the pp axis per token, parallel/pp.py pp_layers;
+        decode never runs the GPipe ppermute rotation, see
+        measure_prefill_transfer_ms for that schedule). Payloads carry the
+        batch dimension: a decode-step activation is (B, 1, dim)."""
+        return self._segment_reduce_ms(1) + self._segment_pp_ms(1)
+
+    def measure_prefill_transfer_ms(self, n_prompt: int) -> float:
+        """Measured transfer estimate for prefilling an n_prompt-token
+        prompt, following the schedules forward() actually runs (VERDICT
+        r4 #9 — the pp cost is the real per-microbatch ppermute structure,
+        not a psum approximation). prefill() feeds the prompt in
+        prefill_chunk-sized segments and forward() picks the schedule PER
+        SEGMENT, so the estimate sums per-segment costs: a segment where
+        gpipe_microbatches(t, pp) returns M > 1 does (M + pp - 2)
+        activation hops of (B, t/M, dim) over the pp ring plus ONE final
+        output psum of (B, t, dim) (pp_layers_gpipe); shorter segments take
+        the all-stages scheme's pp psums of (B, t, dim). tp/ep reduces
+        scale with t like the decode model. Returns total ms."""
+        if self.mesh is None:
+            return 0.0
+        total = 0.0
+        left = n_prompt
+        while left > 0:
+            t = min(self.prefill_chunk, left)
+            total += self._segment_reduce_ms(t) + self._segment_pp_ms(t)
+            left -= t
+        return total
+
+    def _segment_reduce_ms(self, t: int) -> float:
+        """tp/ep per-layer reduce cost for one T-token forward segment —
+        the shared collective structure of the decode and prefill
+        estimates (payload (B, T, dim); netstats.estimate_decode_wire
+        models the same shape)."""
         from .netstats import measure_allreduce_ms
 
         if self.mesh is None:
             return 0.0
         tp = self.mesh.shape.get("tp", 1)
         ep = self.mesh.shape.get("ep", 1)
+        elems = self.batch * t * self.spec.dim
         total = 0.0
         if self.spec.is_moe and ep > 1:
             if tp > 1:  # attention wo reduce stays tp-only
-                total += (measure_allreduce_ms(self.mesh, self.spec.dim)
+                total += (measure_allreduce_ms(self.mesh, elems)
                           * self.spec.n_layers)
-            total += (measure_allreduce_ms(self.mesh, self.spec.dim,
+            total += (measure_allreduce_ms(self.mesh, elems,
                                            axes=("ep", "tp"))
                       * self.spec.n_layers)
         elif tp > 1:
-            per = measure_allreduce_ms(self.mesh, self.spec.dim)
+            per = measure_allreduce_ms(self.mesh, elems)
             reduces = (1 + self.spec.n_active_experts) if self.spec.is_moe else 2
             total += per * reduces * self.spec.n_layers
-        pp = self.mesh.shape.get("pp", 1)
-        if pp > 1:  # per-stage activation handoff (parallel/pp.py)
-            total += (measure_allreduce_ms(self.mesh, self.spec.dim,
-                                           axes=("pp",)) * pp)
         return total
+
+    def _segment_pp_ms(self, t: int) -> float:
+        """pp collective cost for one T-token forward segment, following
+        the schedule forward() picks for that length: GPipe microbatch
+        rotation (long segments) or the all-stages per-stage psum."""
+        from ..parallel.pp import gpipe_microbatches
+        from .netstats import measure_allreduce_ms, measure_ppermute_ms
+
+        pp = (self.mesh.shape.get("pp", 1) if self.mesh is not None else 1)
+        if pp <= 1:
+            return 0.0
+        elems = self.batch * t * self.spec.dim
+        n_mb = gpipe_microbatches(t, pp) if self.pp_gpipe else 1
+        if n_mb > 1:
+            hops = n_mb + pp - 2
+            return (measure_ppermute_ms(
+                self.mesh, self.batch * (t // n_mb) * self.spec.dim) * hops
+                + measure_allreduce_ms(self.mesh, elems, axes=("pp",)))
+        return measure_allreduce_ms(self.mesh, elems, axes=("pp",)) * pp
 
     # -- compiled steps ---------------------------------------------------
 
@@ -552,10 +609,18 @@ class Engine:
         """Prefill + decode loop (ref: src/apps/dllama/dllama.cpp:14-91).
 
         eos_id: stop token id, or a set of them (instruct models often end
-        turns with a marker token distinct from the header eos)."""
+        turns with a marker token distinct from the header eos).
+
+        max_tokens is a HARD cap on emitted tokens — max_tokens <= 0 emits
+        nothing (prefill still advances the cache), exactly like the
+        lookup/batch iterator paths (one contract, VERDICT r4 #9)."""
         stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
         stats = RunStats()
         out: list[int] = []
+
+        if max_tokens <= 0:
+            self.prefill(prompt)
+            return GenerationResult(out, stats)
 
         t0 = time.perf_counter()
         logits = self.prefill(prompt)
@@ -665,10 +730,8 @@ class Engine:
 
         if max_tokens <= 0:
             # budget-0 emits nothing (prefill still advances the cache) —
-            # matching the API server's plain token iterator at n_gen == 0.
-            # NOTE: Engine.generate() emits its first sampled token BEFORE
-            # checking the budget, so it returns 1 token at max_tokens=0;
-            # the iterator semantics here treat the budget as a hard cap
+            # the same hard-cap contract as Engine.generate() and the API
+            # server's plain token iterator at n_gen == 0
             self.prefill(prompt)
             self.last_accept_stats = (1, 0)
             return
@@ -905,9 +968,9 @@ class Engine:
         server's batch endpoint streams from. Each yield is one decode
         step's tokens: b entries, the row's newly sampled token (a stop
         token is included, then the row stops — generate() parity) or None
-        for rows that are done/past budget. The first yield carries every
-        row's prefill-step sample (emitted BEFORE the budget check, like
-        generate()'s first token).
+        for rows that are done/past budget. max_tokens is a hard cap like
+        generate()'s: max_tokens <= 0 prefills but samples/emits nothing
+        (no coins leave the shared sampler stream).
 
         `stop_flags` is an optional (b,) bool array OWNED BY THE CALLER:
         setting stop_flags[i] = True between steps retires row i — the API
@@ -940,6 +1003,9 @@ class Engine:
             tok = jax.device_put(tok, self._token_sharding)
         logits, self.cache = pre_fn(
             self.params, tok, jnp.asarray(lens - 1), self.cache)
+        if max_tokens <= 0:  # hard-cap contract, same as generate(); no
+            self.pos = int(lens.max())  # D2H fetch for discarded logits
+            return
         logits_np = self.fetch_logits(logits)
 
         n_out = np.zeros(b, np.int64)
@@ -1040,6 +1106,9 @@ class Engine:
         n_vocab = min(vocab_size or self.spec.vocab_size,
                       self.spec.vocab_size)
         logits = self.prefill(prompt)
+        if max_tokens <= 0:  # hard-cap contract, same as generate()
+            self.last_device_steps = 0
+            return []
         # every stepped token is followed by its forward's cache write at
         # pos, so writes stay < seq_len; the final token is never stepped
         # (see below), so the loop can emit at the exact context edge
@@ -1152,6 +1221,10 @@ class Engine:
             tok = jax.device_put(tok, self._token_sharding)
         logits, self.cache = pre_fn(
             self.params, tok, jnp.asarray(lens - 1), self.cache)
+        if max_tokens <= 0:  # hard-cap contract, same as generate()
+            self.pos = int(lens.max())
+            self.last_device_steps = 0
+            return [[] for _ in range(b)]
 
         spec = self.spec
         seq_len = self.seq_len

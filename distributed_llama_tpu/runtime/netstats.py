@@ -208,3 +208,40 @@ def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
     sync(run(x))
     dt = time.perf_counter() - t0
     return dt / iters * 1e3
+
+
+def measure_ppermute_ms(mesh, payload_elems: int, iters: int = 16,
+                        axis: str = "pp") -> float:
+    """Time one f32 next-neighbor ppermute of `payload_elems` over `axis` —
+    the GPipe microbatch activation hop (parallel/pp.py pp_layers_gpipe's
+    shift()). Same sync discipline as measure_allreduce_ms. Returns ms per
+    hop, 0.0 when the axis is absent/size 1."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape.get(axis, 1)
+    if n <= 1:
+        return 0.0
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    @jax.jit
+    def run(x):
+        def body(v):
+            for _ in range(iters):
+                v = jax.lax.ppermute(v, axis, perm)
+            return v
+        return shard_map(body, mesh=mesh, in_specs=P(axis),
+                         out_specs=P(axis), check_vma=False)(x)
+
+    x = jax.device_put(np.ones((n, payload_elems), np.float32),
+                       NamedSharding(mesh, P(axis)))
+
+    def sync(out):
+        np.asarray(out.addressable_shards[0].data)
+
+    sync(run(x))  # compile + warm
+    t0 = time.perf_counter()
+    sync(run(x))
+    dt = time.perf_counter() - t0
+    return dt / iters * 1e3

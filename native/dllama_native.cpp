@@ -245,4 +245,15 @@ int32_t dllama_sampler_sample(void* h, float* logits, int32_t n) {
     return cand[last];
 }
 
+// Bulk sequential xorshift* f32 stream (raw <0,1) values, no scaling —
+// callers apply the reference tests' `/ 120.0` as a float64 divide to
+// match C's double-then-narrow arithmetic). The reference's golden block
+// tests seed hundreds of MB of weights from this stream
+// (ref: src/llama2-tasks-test.cpp:555-569); a Python-loop xorshift at that
+// scale is minutes, this is ~1 s. Returns the advanced state.
+uint64_t dllama_rng_fill_f32(uint64_t state, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) out[i] = rand_f32(&state);
+    return state;
+}
+
 }  // extern "C"

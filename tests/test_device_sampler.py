@@ -64,6 +64,66 @@ def test_sample_token_matches_host_sampler(rng, topp):
         assert int(state[1]) == host.rng_state & 0xFFFFFFFF
 
 
+@pytest.mark.parametrize("shape", ["peaked", "uniform", "mixed"])
+def test_sample_token_topk_window_parity_large_vocab(rng, shape):
+    """The k=512 top-k fast path (active only when vocab > 1024) at vocab
+    4096 (ADVICE r4): its claim is bit-exact identity with the full-argsort
+    path, so compare against a second device stream with the fast path
+    forced off. "peaked" logits keep the nucleus inside the window (fast
+    path taken), "uniform" logits spread the nucleus over ~3.7k tokens so
+    cum(topv) never exceeds topp and the lax.cond runs the full sort, and
+    "mixed" alternates — token streams and rng states must stay identical
+    either way. (Host-Sampler parity at this vocab is only epsilon-exact:
+    the documented f32-vs-f64 CDF deviation — see the peaked host check.)"""
+    vocab = 4096
+    state_fast = state_from_seed(77)
+    state_full = state_from_seed(77)
+    host = Sampler(vocab, temperature=1.0, topp=0.9, seed=77,
+                   backend="python")
+    host_mismatch = 0
+    for i in range(40):
+        if shape == "peaked" or (shape == "mixed" and i % 2 == 0):
+            logits = rng.standard_normal(vocab).astype(np.float32) * 4.0
+        else:
+            # near-uniform: top-512 cum ≈ 512/4096 = 0.125 < topp=0.9,
+            # so the window guard must reject and run the full sort
+            logits = rng.standard_normal(vocab).astype(np.float32) * 0.01
+        x = jnp.asarray(logits)
+        tok, state_fast = sample_token(x, state_fast, 1.0, 0.9)
+        ref, state_full = sample_token(x, state_full, 1.0, 0.9,
+                                       _force_full=True)
+        assert int(tok) == int(ref), (shape, i)
+        assert (state_fast == state_full).all()
+        # host stays in rng lock-step; its token may differ only with the
+        # ~1% per-draw f32-epsilon odds on near-uniform distributions
+        want = host.sample(logits.copy())
+        host_mismatch += int(tok) != want
+        assert int(state_fast[0]) == host.rng_state >> 32
+        assert int(state_fast[1]) == host.rng_state & 0xFFFFFFFF
+    assert host_mismatch <= 3, host_mismatch
+
+
+def test_sample_token_topk_window_boundary_fallback(rng):
+    """A nucleus that needs MORE than the 512-entry window but where some
+    window prefix does exceed topp is impossible (cumsum is monotone), but
+    the n_cand < k disjunct matters: fewer than 512 cutoff-survivors with
+    tiny cum must still use the window (truncate at n_cand) — parity with
+    the host on a distribution engineered for exactly that."""
+    vocab = 4096
+    # ~100 tokens clearly above the cutoff, the rest far below: n_cand < k
+    # while cum(top 100) ≈ 1 > topp — fast path, truncation at cum > topp
+    logits = np.full(vocab, -12.0, np.float32)
+    hot = rng.choice(vocab, size=100, replace=False)
+    logits[hot] = rng.standard_normal(100).astype(np.float32)
+    host = Sampler(vocab, temperature=0.8, topp=0.95, seed=5,
+                   backend="python")
+    state = state_from_seed(5)
+    for i in range(20):
+        want = host.sample(logits.copy())
+        tok, state = sample_token(jnp.asarray(logits), state, 0.8, 0.95)
+        assert int(tok) == want, i
+
+
 def test_topp_empty_nucleus_edge_parity():
     """topp < 1/n with near-uniform probs leaves no cutoff candidate
     (ADVICE r2): host, device (and native, when built) must all fall back

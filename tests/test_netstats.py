@@ -69,3 +69,56 @@ def test_engine_wire_surface():
     est = eng.wire_estimate()
     assert est.sent_kb_per_token > 0
     assert eng.measure_transfer_ms() > 0
+
+
+def test_measured_ppermute_runs():
+    from distributed_llama_tpu.runtime.netstats import measure_ppermute_ms
+
+    ms = measure_ppermute_ms(make_mesh(pp=4), 4096, iters=4)
+    assert ms > 0
+    assert measure_ppermute_ms(make_mesh(tp=8), 4096) == 0.0
+
+
+def test_engine_prefill_transfer_models_gpipe_schedule(monkeypatch):
+    """VERDICT r4 #9: the prefill T estimate follows the schedule forward()
+    picks — GPipe segments are costed as (M + pp - 2) microbatch ppermute
+    hops + one output psum, short segments as pp whole-activation psums."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.parallel.pp import gpipe_microbatches
+    from distributed_llama_tpu.models.params import load_params
+    from distributed_llama_tpu.runtime import Engine
+    from test_model_forward import make_spec, dense_weights
+
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     n_layers=4, seq_len=256)
+    host, _ = dense_weights(spec, seed=2)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    eng = Engine(spec, params, make_mesh(pp=2, tp=1),
+                 compute_dtype=jnp.float32, cache_dtype=jnp.float32)
+    assert eng.pp_gpipe
+
+    calls = []
+    from distributed_llama_tpu.runtime import netstats
+
+    monkeypatch.setattr(netstats, "measure_allreduce_ms",
+                        lambda mesh, n, iters=16, axes=("tp",):
+                        calls.append(("psum", n, axes)) or 1.0)
+    monkeypatch.setattr(netstats, "measure_ppermute_ms",
+                        lambda mesh, n, iters=16, axis="pp":
+                        calls.append(("hop", n, axis)) or 0.5)
+
+    t = 128  # gpipe engages: M microbatches of t/M tokens
+    m = gpipe_microbatches(t, 2)
+    assert m > 1
+    total = eng.measure_prefill_transfer_ms(t)
+    hops = [c for c in calls if c[0] == "hop"]
+    psums = [c for c in calls if c[0] == "psum"]
+    assert len(hops) == 1 and hops[0][1] == (t // m) * spec.dim
+    assert len(psums) == 1 and psums[0][1] == t * spec.dim
+    assert total == (m + 2 - 2) * 0.5 + 1.0
+
+    calls.clear()
+    short = eng.measure_prefill_transfer_ms(8)  # all-stages: pp psums
+    assert [c[0] for c in calls] == ["psum"]
+    assert short == 2 * 1.0
