@@ -84,6 +84,17 @@ MIXTRAL_MOE = ModelSpec(  # Mixtral 8x7B production dims, truncated to 4
     hidden_act=HiddenAct.SILU, rope_theta=1000000.0,
     n_experts=8, n_active_experts=2)
 
+GROK1_TRUNC = ModelSpec(  # Grok-1 PRODUCTION widths (dim 6144, 8 experts
+    # of hidden 32768, GQA 48/8, 131k vocab, GELU, the 4-norm block —
+    # ref: convert-grok-1.py:59-70 / grok1-tasks.cpp), truncated to 2
+    # layers: one full-width layer is 2.72 GB packed Q40, so 2 layers +
+    # embeddings (~7.6 GB) saturate a 16 GB chip while ms/token/layer
+    # extrapolates to the full 64-layer model (VERDICT r4 #5)
+    arch=ArchType.GROK1, dim=6144, hidden_dim=32768, n_layers=2,
+    n_heads=48, n_kv_heads=8, vocab_size=131072, seq_len=2048,
+    hidden_act=HiddenAct.GELU, rope_theta=10000.0,
+    n_experts=8, n_active_experts=2)
+
 
 def _rand_q40(rng: np.random.Generator, *shape: int) -> QuantizedTensor:
     """Random Q40 weight of logical shape (..., n): packed nibbles + scales
@@ -114,6 +125,9 @@ def synth_q40_params(spec: ModelSpec, seed: int = 0, dtype=jnp.bfloat16) -> dict
             "wv": _rand_q40(rng, kv, d),
             "wo": _rand_q40(rng, d, d),
         }
+        if spec.arch == ArchType.GROK1:  # the 4-norm Grok block
+            lw["rms_moe"] = jnp.ones((d,), jnp.float32)
+            lw["rms_ffn2"] = jnp.ones((d,), jnp.float32)
         if spec.is_moe:
             lw["moe_router"] = jnp.asarray(
                 rng.standard_normal((spec.n_experts, d), dtype=np.float32)
@@ -382,6 +396,59 @@ def _batch_row(params, spec: ModelSpec, repeats: int, b: int = 8) -> dict:
     }
 
 
+def _batch_lookup_row(params, spec: ModelSpec, repeats: int,
+                      b: int = 8) -> dict:
+    """Batched SPECULATIVE decode (VERDICT r4 #7): b rows amortize one
+    weight read per verify forward AND each row confirms multiple draft
+    tokens per forward — the two serving multipliers compose. Same
+    max-acceptance regime as _lookup_row (per-row histories primed with
+    each row's own fixed-point continuation); the host loop pays the
+    tunnel dispatch per forward, but multi-token accepts mean ~1/k the
+    forwards of the plain batch loop."""
+    import gc
+    import time
+
+    eng = Engine(spec, params, compute_dtype=jnp.bfloat16,
+                 cache_dtype=jnp.bfloat16, max_seq_len=512, batch=b)
+    n, draft_len = 96, 7
+    prompts = [[1, 17 + i, 93, 5 + i] for i in range(b)]
+
+    # per-row fixed-point prime (the _lookup_row discipline, batched)
+    streams = eng.generate_batch_lookup(prompts, n, draft_len=draft_len)
+    for _ in range(4):
+        eng.reset()
+        nxt = eng.generate_batch_lookup(
+            prompts, n, draft_len=draft_len,
+            histories=[p + s for p, s in zip(prompts, streams)])
+        if nxt == streams:
+            break
+        streams = nxt
+    primed = [p + s for p, s in zip(prompts, streams)]
+
+    best = None
+    outs = None
+    for i in range(repeats + 1):  # run 0 warms remaining widths
+        eng.reset()
+        t0 = time.perf_counter()
+        outs = eng.generate_batch_lookup(prompts, n, draft_len=draft_len,
+                                         histories=primed)
+        dt = time.perf_counter() - t0
+        if i > 0:
+            best = dt if best is None else min(best, dt)
+    forwards, toks = eng.last_accept_stats
+    agg_tok_s = sum(len(o) for o in outs) / best
+    del eng
+    gc.collect()
+    return {
+        "metric": (f"llama2_7b_q40_batch{b}_lookup_decode_agg_tok_per_s_"
+                   "1chip_max_accept"),
+        "value": round(agg_tok_s, 1), "unit": "tok/s",
+        "vs_baseline": None,
+        "tokens_per_forward_all_rows": round(toks / forwards, 2),
+        "batch": b,
+    }
+
+
 def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
     """Extra measured rows for the default 7b run: prefill throughput,
     8k-fill long-context decode (bf16 and fp8 caches — the documented fp8
@@ -414,6 +481,7 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
     # batched decode needs its own engine (batch is a build-time shape);
     # the 7b weights are shared, the extra KV cache is 512-seq x 8 rows
     emit(_batch_row(params, spec, repeats))
+    emit(_batch_lookup_row(params, spec, repeats))
 
 
 def _shardmap_row(engine, params, spec: ModelSpec, repeats: int) -> dict:
@@ -470,13 +538,34 @@ def _moe_row(repeats: int) -> dict:
     return row
 
 
+def _grok_row(repeats: int) -> dict:
+    """Grok-1 decode at PRODUCTION widths (VERDICT r4 #5): the 4-norm GELU
+    MoE block at dim 6144 / hidden 32768 / 131k vocab, 2 layers resident
+    (7.6 GB — a full-width layer is 2.72 GB packed). Needs the chip alone
+    like _moe_row; the per-layer column extrapolates to all 64 layers."""
+    import gc
+
+    params = synth_q40_params(GROK1_TRUNC)
+    eng = Engine(GROK1_TRUNC, params, compute_dtype=jnp.bfloat16,
+                 cache_dtype=jnp.bfloat16)
+    msg = _measure_decode(eng, 128, 0, repeats)
+    row = _decode_row("grok1_fullwidth_q40_decode_ms_per_token_1chip",
+                      GROK1_TRUNC, msg, n_tokens=128)
+    row["ms_per_token_per_layer"] = round(msg / GROK1_TRUNC.n_layers, 4)
+    row["full_depth_64l_ms_per_token_extrapolated"] = round(
+        msg / GROK1_TRUNC.n_layers * 64, 2)
+    del eng, params
+    gc.collect()
+    return row
+
+
 def main() -> None:
     model = os.environ.get("BENCH_MODEL", "7b")
     # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
     spec = {"7b": LLAMA2_7B, "8b": LLAMA3_8B, "13b": LLAMA2_13B,
-            "moe": MIXTRAL_MOE}.get(model, TINY)
+            "moe": MIXTRAL_MOE, "grok": GROK1_TRUNC}.get(model, TINY)
     # long-context variants: BENCH_SEQ widens the cache, BENCH_FILL starts
     # decode at a deep fill (the flash kernel reads ~fill bytes of cache)
     seq = int(os.environ.get("BENCH_SEQ", str(min(spec.seq_len, 2048))))
@@ -493,7 +582,8 @@ def main() -> None:
     metric = {"7b": "llama2_7b_q40_decode_ms_per_token_1chip",
               "8b": "llama3_8b_q40_decode_ms_per_token_1chip",
               "13b": "llama2_13b_q40_decode_ms_per_token_1chip",
-              "moe": "mixtral_moe_q40_decode_ms_per_token_1chip"}.get(
+              "moe": "mixtral_moe_q40_decode_ms_per_token_1chip",
+              "grok": "grok1_fullwidth_q40_decode_ms_per_token_1chip"}.get(
         model, "tiny_llama_q40_decode_ms_per_token")
     base = {"7b": BASELINE_MS_PER_TOKEN,
             "8b": BASELINE_8B_MS_PER_TOKEN,
@@ -541,9 +631,10 @@ def main() -> None:
             import gc
 
             _variant_rows(engine, params, spec, repeats, emit)
-            del engine, params  # free the 7b weights before the MoE row
+            del engine, params  # free the 7b weights before the MoE rows
             gc.collect()
             emit(_moe_row(repeats))
+            emit(_grok_row(repeats))
     except Exception as e:  # partial rows survive outages; interrupts
         out["error"] = f"{type(e).__name__}: {e}"[:400]  # (Ctrl-C) and
         print(json.dumps(out), flush=True)  # timeout kills still rc != 0

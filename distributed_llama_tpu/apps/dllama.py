@@ -77,12 +77,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=["bf16", "f32", "f8"],
                    help="KV-cache element type; f8 (e4m3) halves cache "
                         "memory — 2x context per device (net-new vs the "
-                        "reference's f32-only cache). On TPUs without fp8 "
-                        "hardware (v5e) the read-side upcast is software: "
-                        "measured 7B decode at 7680-deep fill is 42.2 vs "
-                        "19.0 ms/token (bench.py 8kfill rows), so prefer "
-                        "f8 only when context memory is the binding "
-                        "constraint")
+                        "reference's f32-only cache) — at decode-rate "
+                        "PARITY with bf16: the flash kernel upcasts f8 "
+                        "blocks via in-register bit reassembly "
+                        "(ops/pallas_attention._f8_bits_to; measured 7B "
+                        "decode at 7680-deep fill 18.9 vs 18.8 ms/token, "
+                        "r5 A/B — r4's 2.3x astype stall is gone)")
     p.add_argument("--pallas", action="store_true", default=None,
                    help="force the fused Pallas kernels on (default: on for "
                         "TPU backends, including multi-device meshes via "
@@ -140,6 +140,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator", default=None,
                    help="jax.distributed coordinator address host:port, "
                         "reachable from every node (required with --nnodes)")
+    p.add_argument("--push-weights", action="store_true",
+                   help="cluster weight distribution: rank 0 streams the "
+                        ".m and broadcasts each tensor's bytes, so workers "
+                        "need NO local model file (the reference root's "
+                        "per-worker TCP weight push, transformer.cpp:562-"
+                        "591). Pass on EVERY process; workers may omit "
+                        "--model")
     return p
 
 
@@ -155,28 +162,39 @@ def build_engine(args):
     from ..sampler import Sampler
     from ..tokenizer import Tokenizer
 
-    if not args.model or not args.tokenizer:
-        sys.exit("error: --model and --tokenizer are required")
+    multihost = jax.process_count() > 1
+    # root-push mode: only rank 0 needs the .m — workers receive spec +
+    # weights over the broadcast protocol (parallel/multihost.py)
+    pushed_worker = (getattr(args, "push_weights", False) and multihost
+                     and jax.process_index() > 0)
+    if (not args.model and not pushed_worker) or not args.tokenizer:
+        sys.exit("error: --model and --tokenizer are required "
+                 "(--model optional for --push-weights workers)")
 
     wft = None
     if args.weights_float_type:
         wft = FloatType[args.weights_float_type.upper()]
 
-    spec = read_spec(args.model, weights_float_type=wft)
-    print(f"⏩ {args.model}: arch={spec.arch.name} dim={spec.dim} "
-          f"layers={spec.n_layers} heads={spec.n_heads}/{spec.n_kv_heads} "
-          f"seq={spec.seq_len}")
+    if pushed_worker:
+        from ..parallel.multihost import bcast_spec
+        spec, model_fp = bcast_spec(None)
+    else:
+        spec = read_spec(args.model, weights_float_type=wft)
+        # sampled content hash of the weights file — folded into the
+        # KV-session fingerprint always, and into the cluster config check
+        # when multihost
+        model_fp = content_fingerprint(args.model)
+        if getattr(args, "push_weights", False) and multihost:
+            from ..parallel.multihost import bcast_spec
+            bcast_spec(spec, model_fp)
+    print(f"⏩ {args.model or '<pushed>'}: arch={spec.arch.name} "
+          f"dim={spec.dim} layers={spec.n_layers} "
+          f"heads={spec.n_heads}/{spec.n_kv_heads} seq={spec.seq_len}")
 
     mode = "q40" if spec.weights_float_type == FloatType.Q40 else "dense"
     cdt = jnp.bfloat16 if args.compute_dtype == "bf16" else jnp.float32
     kdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
            "f8": jnp.float8_e4m3fn}[args.cache_dtype]
-
-    # sampled content hash of the weights file — folded into the KV-session
-    # fingerprint always, and into the cluster config check when multihost
-    model_fp = content_fingerprint(args.model)
-
-    multihost = jax.process_count() > 1
     if multihost:
         # every process must agree on the mesh/dtype flags (the reference
         # memcpys its spec struct over the socket and hopes — we verify).
@@ -211,7 +229,10 @@ def build_engine(args):
                       # API-mode speculation likewise uses each process's
                       # own --lookup-decode: a mismatch would diverge the
                       # verify-forward widths and hang a collective
-                      args.lookup_decode])
+                      args.lookup_decode,
+                      # weight-push is a protocol phase: every process must
+                      # run (or not run) the same broadcast sequence
+                      int(getattr(args, "push_weights", False))])
 
     mesh = None
     if (args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1
@@ -232,8 +253,15 @@ def build_engine(args):
     # streamed sharded load: one tensor resident at a time, each shard
     # placed straight onto its device (ref weight push: transformer.cpp:562-621)
     t0 = time.time()
+    tensor_src = None
+    if getattr(args, "push_weights", False) and multihost:
+        # rank 0 streams its file into the broadcast; workers consume the
+        # identical tensor stream with no local .m
+        from ..parallel.multihost import bcast_model_tensors
+        tensor_src = bcast_model_tensors(spec, args.model or None)
     params, lstats = load_params_streamed(
-        spec, args.model, mesh, mode=mode, dtype=cdt, q80_collectives=q80)
+        spec, args.model, mesh, mode=mode, dtype=cdt, q80_collectives=q80,
+        tensors=tensor_src)
     print(f"⏩ loaded {lstats.total_bytes / 1e9:.2f} GB in "
           f"{time.time()-t0:.1f}s (peak host "
           f"{lstats.peak_host_bytes / 1e6:.0f} MB)")

@@ -186,11 +186,13 @@ def read_spec(path: str, weights_float_type: FloatType | None = None) -> ModelSp
     return spec
 
 
-def _read_tensor(f, name: str, shape: tuple[int, ...], ftype: FloatType) -> HostTensor:
-    nbytes = _tensor_bytes(shape, ftype)
-    buf = f.read(nbytes)
-    if len(buf) != nbytes:
-        raise EOFError(f"model file truncated at tensor {name}")
+def tensor_from_bytes(name: str, shape: tuple[int, ...], ftype: FloatType,
+                      buf: bytes) -> HostTensor:
+    """Decode one tensor's raw FILE bytes into a HostTensor — the shared
+    tail of the file reader and the multihost root-push receiver
+    (parallel/multihost.bcast_model_tensors), which ships exactly these
+    bytes over the wire like the reference's per-worker weight push
+    (ref: src/transformer.cpp:562-621)."""
     if ftype == FloatType.F32:
         return HostTensor(name, ftype, shape, data=np.frombuffer(buf, np.float32).reshape(shape).copy())
     if ftype == FloatType.F16:
@@ -207,6 +209,14 @@ def _read_tensor(f, name: str, shape: tuple[int, ...], ftype: FloatType) -> Host
         return HostTensor(name, ftype, shape,
                           scales=scales.reshape(d, nb), packed=q.reshape(d, nb, 32))
     raise ValueError(ftype)
+
+
+def _read_tensor(f, name: str, shape: tuple[int, ...], ftype: FloatType) -> HostTensor:
+    nbytes = _tensor_bytes(shape, ftype)
+    buf = f.read(nbytes)
+    if len(buf) != nbytes:
+        raise EOFError(f"model file truncated at tensor {name}")
+    return tensor_from_bytes(name, shape, ftype, buf)
 
 
 def iter_model_tensors(path: str, spec: ModelSpec) -> Iterator[HostTensor]:

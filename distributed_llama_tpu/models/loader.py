@@ -361,19 +361,25 @@ def _concat_host(ts: list[HostTensor], mode: str) -> list[HostTensor]:
 
 def load_params_streamed(
     spec: ModelSpec,
-    path: str,
+    path: str | None,
     mesh=None,
     *,
     mode: str = "q40",
     dtype=jnp.bfloat16,
     q80_collectives: bool = False,
     fuse: bool | None = None,
+    tensors=None,
 ) -> tuple[dict, LoadStats]:
     """Stream the `.m` file into a final, placed params pytree.
 
     fuse defaults to tp == 1 (matching Engine's single-shard fast path).
     Returns (params, LoadStats) — peak_host_bytes is the loader's measured
     high-water mark of resident file-tensor bytes.
+
+    tensors: optional HostTensor iterator replacing the file read — the
+    multihost root-push path feeds parallel.multihost.bcast_model_tensors
+    here so a worker WITHOUT the `.m` places shards straight from the
+    root's broadcast (path may then be None on workers).
     """
     assert mode in ("dense", "q40")
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
@@ -415,7 +421,9 @@ def load_params_streamed(
             return p["layers"][l % n_slot], l // n_slot
         return p["layers"][l], None
 
-    for t in iter_model_tensors(path, spec):
+    if tensors is None:
+        tensors = iter_model_tensors(path, spec)
+    for t in tensors:
         key = _leaf_key(t.name)
         if kv_rep > 1 and key in ("wk", "wv"):
             # replicate BEFORE accounting so live/peak measure the r-fold

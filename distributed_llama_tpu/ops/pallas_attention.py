@@ -44,6 +44,30 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEF_BLOCK_S = 512
 NEG_INF = -1e30
+F8_DTYPE = jnp.float8_e4m3fn
+
+
+def _f8_bits_to(u8, out_dtype):
+    """e4m3fn bits (uint8) -> out_dtype, vectorized f32-bit reassembly.
+
+    Mosaic's own fp8 `astype` on v5e (no native fp8) lowers to a slow
+    conversion that cost +0.74 ms/layer/token at 8k fill — the whole fp8
+    KV-cache regression of BENCH_r04 (tools/exp_f8_flash.py: astype 4.447
+    vs 3.686 ms/call for this decode, bit-exact). 16-bit vector shifts are
+    also unsupported, so the reassembly stays in 32-bit lanes: a normal
+    number's f32 bits are sign<<31 | (exp+120)<<23 | mant<<20; subnormals
+    (mag < 8) take an int->float ladder (value = mant * 2^-9, exact in
+    3 mantissa bits). Writes saturate (models/transformer._to_cache_dtype),
+    so NaN/inf bit patterns never occur in the cache."""
+    i = u8.astype(jnp.int32)
+    sign = (i & 0x80) << 24
+    mag = i & 0x7F
+    normal = (mag << 20) + (120 << 23)
+    sub = mag.astype(jnp.float32) * jnp.float32(2.0 ** -9)
+    bits = jnp.where(mag < 8, jax.lax.bitcast_convert_type(sub, jnp.int32),
+                     normal) | sign
+    f = jax.lax.bitcast_convert_type(bits, jnp.float32)
+    return f if out_dtype == jnp.float32 else f.astype(out_dtype)
 # cap on T*G query rows per head panel: bounds the (rows, SB) f32 score tile
 # in VMEM (1024x512x4 = 2 MB; acc another 512 KB). Prefill chunks above it
 # fall back to the dense path — the engine's default chunk (256) stays under
@@ -71,9 +95,18 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref,
         q = q_ref[0]                               # (T*G, hs)
         k = k_ref[0]                               # (SB, hs)
         v = v_ref[0]
-        if k.dtype != q.dtype:
-            # sub-bf16 cache (fp8 option): HBM/VMEM stay narrow, the upcast
-            # is per-block VPU work right before the dot
+        if k.dtype == F8_DTYPE:
+            # e4m3 cache: HBM/VMEM/DMA stay narrow; reinterpret the block's
+            # bits in-register (free) and do the exact upcast as cheap
+            # 32-bit-lane VPU work before the dot (Mosaic's fp8 astype was
+            # the BENCH_r04 2.3x f8 stall; an XLA-side whole-cache bitcast
+            # materialized a copy per step and cost another ~50%)
+            k = _f8_bits_to(jax.lax.bitcast_convert_type(k, jnp.uint8),
+                            q.dtype)
+            v = _f8_bits_to(jax.lax.bitcast_convert_type(v, jnp.uint8),
+                            q.dtype)
+        elif k.dtype != q.dtype:
+            # other sub-bf16 cache dtypes: generic per-block upcast
             k = k.astype(q.dtype)
             v = v.astype(q.dtype)
 

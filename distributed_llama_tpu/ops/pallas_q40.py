@@ -92,7 +92,15 @@ def _dequant_dot(x_lo, x_hi, xsum, pk_u8, s_raw,
                  *, out_dtype, scales_u16, mxu_bf16):
     """The kernel math on loaded blocks: dequantize a (TD, M) packed tile in
     registers and contract with the pre-split activations. Activations must
-    already be in the contraction dtype (bf16 when mxu_bf16)."""
+    already be in the contraction dtype (bf16 when mxu_bf16).
+
+    (A round-5 re-try of the pk-substitution — fold lo = pk - 16*hi into
+    the contraction to drop the `& 0xF` — was REJECTED twice over: timing
+    FLAT at 1.000x (the and-op co-issues off the critical path) and 6.4%
+    relative error (DEFAULT-precision dots pass f32 operands through the
+    MXU as bf16; pk's 8 value bits fill the mantissa and the 16x
+    cancellation amplifies the truncation). Full record:
+    tools/exp_pk_decode.py.)"""
     pk = pk_u8.astype(jnp.int32)                         # (TD, M=16*nb)
     lo = (pk & 0xF).astype(jnp.float32)
     hi = (pk >> 4).astype(jnp.float32)

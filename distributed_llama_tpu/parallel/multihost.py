@@ -194,6 +194,78 @@ def check_config(fingerprint: list[int]) -> None:
             "sampler flags")
 
 
+def bcast_spec(spec, model_fp: int = 0):
+    """Root-push phase 0: rank 0 broadcasts the model spec + weight-content
+    fingerprint so FILE-LESS workers (--push-weights) can participate in
+    the config check and build their engine without ever reading a `.m`.
+    Non-root callers pass spec=None; returns (spec, model_fp) on every
+    rank. Matches the reference root shipping its TransformerSpec struct
+    ahead of the weight push (ref: src/transformer.cpp:633-644) — but as
+    explicit fields, not a raw memcpy."""
+    from ..models.spec import ArchType, HiddenAct, ModelSpec
+    from ..quants.types import FloatType
+
+    if spec is not None:
+        fields = [int(spec.arch), spec.dim, spec.hidden_dim, spec.n_layers,
+                  spec.n_heads, spec.n_kv_heads, spec.vocab_size,
+                  spec.seq_len, int(spec.hidden_act),
+                  int(np.float32(spec.rope_theta).view(np.int32)),
+                  spec.n_experts, spec.n_active_experts,
+                  int(spec.weights_float_type), spec.version,
+                  model_fp & 0xFFFFFFFF]
+    else:
+        fields = [0] * 15
+    f = _bcast(np.asarray(fields, np.int64))
+    out = ModelSpec(
+        arch=ArchType(int(f[0])), dim=int(f[1]), hidden_dim=int(f[2]),
+        n_layers=int(f[3]), n_heads=int(f[4]), n_kv_heads=int(f[5]),
+        vocab_size=int(f[6]), seq_len=int(f[7]),
+        hidden_act=HiddenAct(int(f[8])),
+        rope_theta=float(np.int32(f[9]).view(np.float32)),
+        n_experts=int(f[10]), n_active_experts=int(f[11]),
+        weights_float_type=FloatType(int(f[12])), version=int(f[13]))
+    return out, int(f[14])
+
+
+def bcast_model_tensors(spec, path: str | None):
+    """Root-push phase 1: a HostTensor generator on EVERY rank. Rank 0
+    streams its `.m` file tensor-by-tensor and broadcasts each tensor's
+    raw file bytes; other ranks receive and decode the identical bytes —
+    so a worker needs NO local model file (the reference's root pushes
+    every worker its slice over TCP the same way,
+    ref: src/transformer.cpp:562-591,685-720). One tensor is resident at a
+    time on each host (the streamed-loader memory contract holds); feed
+    this to models.loader.load_params_streamed(tensors=...), which places
+    only this host's shards and drops the rest."""
+    from ..io.model_file import (_tensor_bytes, model_tensor_plan, read_spec,
+                                 tensor_from_bytes)
+
+    root = jax.process_index() == 0
+    f = None
+    if root:
+        assert path is not None, "--push-weights root needs the model file"
+        header_size = getattr(spec, "_header_size", None)
+        if header_size is None:
+            header_size = getattr(
+                read_spec(path, spec.weights_float_type), "_header_size")
+        f = open(path, "rb")
+        f.seek(header_size)
+    try:
+        for name, shape, ftype in model_tensor_plan(spec):
+            nbytes = _tensor_bytes(shape, ftype)
+            if root:
+                raw = np.frombuffer(f.read(nbytes), np.uint8)
+                if raw.size != nbytes:
+                    raise EOFError(f"model file truncated at {name}")
+            else:
+                raw = np.zeros(nbytes, np.uint8)
+            raw = _bcast(raw)
+            yield tensor_from_bytes(name, shape, ftype, raw.tobytes())
+    finally:
+        if f is not None:
+            f.close()
+
+
 def broadcast_seed(seed: int) -> int:
     """Agree on one base sampler seed cluster-wide (the CLI default is
     time-based, which would diverge per host)."""

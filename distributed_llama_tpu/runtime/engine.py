@@ -403,12 +403,18 @@ class Engine:
         scale with t like the decode model. Returns total ms."""
         if self.mesh is None:
             return 0.0
+        # measure once per DISTINCT segment length (at most two: the full
+        # chunk and the tail) — the microbench compiles + times real
+        # collectives, so a per-segment loop would redo that ~n_chunks
+        # times for identical numbers
+        n_full, tail = divmod(n_prompt, self.prefill_chunk)
         total = 0.0
-        left = n_prompt
-        while left > 0:
-            t = min(self.prefill_chunk, left)
-            total += self._segment_reduce_ms(t) + self._segment_pp_ms(t)
-            left -= t
+        if n_full:
+            t = self.prefill_chunk
+            total += (self._segment_reduce_ms(t)
+                      + self._segment_pp_ms(t)) * n_full
+        if tail:
+            total += self._segment_reduce_ms(tail) + self._segment_pp_ms(tail)
         return total
 
     def _segment_reduce_ms(self, t: int) -> float:
@@ -930,6 +936,155 @@ class Engine:
                                  draft_len=draft_len, max_ngram=max_ngram,
                                  history=history, stats=stats,
                                  first_fn=first, verify_fn=verify)
+
+    # -- batched speculative (prompt-lookup) greedy generation ------------
+
+    def generate_batch_lookup(
+        self,
+        prompts: list[list[int]],
+        max_tokens: int,
+        eos_id: int | set[int] | None = None,
+        *,
+        draft_len: int = 7,
+        max_ngram: int = 3,
+        vocab_size: int | None = None,
+        histories: list[list[int]] | None = None,
+    ) -> list[list[int]]:
+        """Batched prompt-lookup speculative decoding (VERDICT r4 #7):
+        every row mines its own draft from its own history each step, the
+        drafts RIGHT-PAD to the widest live draft (padding feeds the row's
+        current token again — its writes land beyond the accepted prefix
+        and are overwritten like any unconfirmed draft), and ONE verify
+        forward of (B, 1 + k_max) confirms each row's accepted prefix + 1.
+        Emitted streams are EXACTLY the per-row greedy streams (argmax
+        verify — same contract as generate_lookup_stream), so decode stays
+        weight-read-bound: b rows x multi-token accepts amortize one
+        weight read per forward.
+
+        Greedy only, single host loop. Returns one token list per row
+        (stop token included — generate() parity). `last_accept_stats`
+        holds (verify_forwards, total_tokens) summed over live rows.
+        `histories[i]` (defaults to prompts[i]) seeds row i's draft-mining
+        context, like the single-row stream's `history`."""
+        from .speculative import count_accepted, find_draft
+
+        b = len(prompts)
+        assert b == self.batch, (b, self.batch)
+        assert all(prompts), "empty prompt"
+        stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
+        spec_v = min(vocab_size or self.spec.vocab_size,
+                     self.spec.vocab_size)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        t = int(lens.max())
+        assert t < self.seq_len, "context overflow"
+
+        # greedy argmax ON DEVICE: the verify loop only consumes argmaxes,
+        # and fetching the full (B, T, V) logits per forward is ~8 MB of
+        # D2H — on the tunneled platform that transfer alone capped the
+        # batch-lookup bench at 59 tok/s aggregate; (B, T) int32 is ~256 B
+        amax_key = ("bl_amax", spec_v)
+        if amax_key not in self._steps:
+            self._steps[amax_key] = jax.jit(
+                lambda l: jnp.argmax(
+                    l[..., :spec_v].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32))
+        amax = self._steps[amax_key]
+
+        # whole-batch right-padded prefill (same path as generate_batch)
+        pre_fn = self._compiled_step(("bpre", t), with_logit_index=True)
+        padded = np.zeros((b, t), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+        tok = jnp.asarray(padded)
+        if self._token_sharding is not None:
+            tok = jax.device_put(tok, self._token_sharding)
+        logits, self.cache = pre_fn(
+            self.params, tok, jnp.asarray(lens - 1), self.cache)
+        if max_tokens <= 0:  # hard-cap contract, same as generate()
+            self.pos = int(lens.max())
+            self.last_accept_stats = (1, 0)
+            return [[] for _ in range(b)]
+        first_np = np.asarray(amax(logits))  # (B,)
+
+        out: list[list[int]] = [[] for _ in range(b)]
+        hists: list[np.ndarray] = []
+        cur = np.zeros(b, np.int32)
+        done = np.zeros(b, bool)
+        pos = lens.copy()
+        for i in range(b):
+            tok_i = int(first_np[i])
+            out[i].append(tok_i)
+            cur[i] = tok_i
+            hists.append(np.asarray(
+                (histories[i] if histories is not None else prompts[i])
+                + [tok_i], np.int32))
+            if tok_i in stop_ids:
+                done[i] = True
+        self.pos = int(pos.max())
+        n_forwards = 1
+        # stats are valid even if the loop below never runs (budget 1, or
+        # every row's first token is a stop token)
+        self.last_accept_stats = (n_forwards, sum(len(o) for o in out))
+
+        def alive(i: int) -> bool:
+            return (not done[i] and len(out[i]) < max_tokens
+                    and pos[i] < self.seq_len)
+
+        while any(alive(i) for i in range(b)):
+            drafts: list[list[int]] = []
+            for i in range(b):
+                if alive(i):
+                    k = min(draft_len, self.seq_len - pos[i] - 1,
+                            max_tokens - len(out[i]) - 1)
+                    drafts.append(find_draft(hists[i], k,
+                                             max_ngram=max_ngram)
+                                  if k > 0 else [])
+                else:
+                    drafts.append([])
+            k_max = max(len(d) for d in drafts)
+
+            # rows feed [cur] + draft, padded to 1 + k_max with cur (the
+            # padding's K/V writes sit beyond the accepted prefix and are
+            # overwritten before any later query attends them; rows at the
+            # context edge rely on the scatter's drop-mode OOB writes)
+            seg = np.empty((b, 1 + k_max), np.int32)
+            for i, d in enumerate(drafts):
+                seg[i, 0] = cur[i]
+                seg[i, 1: 1 + len(d)] = d
+                seg[i, 1 + len(d):] = cur[i]
+
+            fn = self._compiled_step(("blookup", 1 + k_max),
+                                     logits_for_all=True)
+            tok_dev = jnp.asarray(seg)
+            posv = jnp.asarray(np.minimum(pos, self.seq_len - 1))
+            if self._token_sharding is not None:
+                tok_dev = jax.device_put(tok_dev, self._token_sharding)
+                posv = jax.device_put(
+                    posv, NamedSharding(self.mesh, P(DP_AXIS)))
+            logits, self.cache = fn(self.params, tok_dev, posv, self.cache)
+            greedy_np = np.asarray(amax(logits))  # (B, 1+k_max)
+            n_forwards += 1
+
+            for i in range(b):
+                if not alive(i):
+                    continue
+                greedy = greedy_np[i]
+                m = count_accepted(drafts[i], greedy)
+                emitted = [int(g) for g in greedy[: m + 1]]
+                for j, tk in enumerate(emitted):
+                    if tk in stop_ids:
+                        emitted = emitted[: j + 1]
+                        done[i] = True
+                        break
+                emitted = emitted[: max_tokens - len(out[i])]
+                pos[i] += len(emitted)  # 1 + accepted
+                out[i].extend(emitted)
+                cur[i] = emitted[-1]
+                hists[i] = np.concatenate(
+                    [hists[i], np.asarray(emitted, np.int32)])
+            self.pos = int(np.minimum(pos, self.seq_len).max())
+            self.last_accept_stats = (n_forwards, sum(len(o) for o in out))
+        return out
 
     # -- batched generation (dp path) -------------------------------------
 

@@ -21,6 +21,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.device_count() == 8, jax.devices()
 
+# persistent compilation cache: the suite's cost is dominated by XLA
+# compiles of the SPMD mesh tests; cached executables cut a warm rerun
+# drastically (VERDICT r4 #10). Keyed by jaxlib version internally, shared
+# across local runs and CI steps.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.expanduser("~"), ".cache",
+                               "dllama_tpu_xla"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

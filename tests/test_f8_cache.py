@@ -8,6 +8,7 @@ q and the softmax state never drop below the compute dtype (k/v upcast at
 the read).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -107,3 +108,21 @@ def test_f8_cache_flash_kernel_interpret():
     assert got.dtype == q.dtype
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=0, atol=2e-2)
+
+
+def test_f8_bits_reassembly_exact_all_codes():
+    """_f8_bits_to (the in-kernel e4m3->bf16/f32 reassembly that replaced
+    Mosaic's slow fp8 astype — tools/exp_f8_flash.py) must agree with the
+    reference astype on EVERY non-NaN e4m3 bit pattern, normals and
+    subnormals, both signs. NaN codes (0x7F/0xFF) are excluded: writes
+    saturate, so the cache never stores them."""
+    from distributed_llama_tpu.ops.pallas_attention import _f8_bits_to
+
+    codes = np.asarray([c for c in range(256) if c & 0x7F != 0x7F],
+                       np.uint8)
+    f8 = jax.lax.bitcast_convert_type(jnp.asarray(codes), jnp.float8_e4m3fn)
+    for out_dtype in (jnp.float32, jnp.bfloat16):
+        want = np.asarray(f8.astype(out_dtype), np.float32)
+        got = np.asarray(_f8_bits_to(jnp.asarray(codes), out_dtype),
+                         np.float32)
+        np.testing.assert_array_equal(got, want)

@@ -17,6 +17,9 @@ import pytest
 
 from distributed_llama_tpu.testing import write_fixture
 
+# compile-heavy SPMD meshes / subprocess clusters: the slow tier (pytest.ini)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # pins the CPU platform before any backend init (a sitecustomize hook may
@@ -81,6 +84,40 @@ def test_two_process_cluster_matches_single(tmp_path):
     assert _gen_line(out_root) == _gen_line(out_single), (
         out_root, out_single)
     assert "worker rank 1 of 2 ready" in out_worker
+    assert "root shut down" in out_worker
+
+
+def test_two_process_cluster_push_weights_fileless_worker(tmp_path):
+    """Root-push weight distribution (VERDICT r4 #8): the worker starts
+    with NO model file — rank 0 broadcasts the spec + every tensor's raw
+    bytes (parallel/multihost.bcast_spec / bcast_model_tensors, the
+    reference's per-worker TCP weight push, transformer.cpp:562-591) and
+    the cluster transcript must still equal the single-process run."""
+    mpath, tpath = _fixture(tmp_path)
+    base = ["--model", mpath, "--tokenizer", tpath, "--prompt", "ab",
+            "--steps", "6", "--seed", "7", "--temperature", "0",
+            "--buffer-float-type", "f32"]
+
+    p, t = _run(["generate", *base])
+    out_single, err = p.communicate(timeout=t)
+    assert p.returncode == 0, err
+
+    port = _free_port()
+    cluster = ["--nnodes", "2", "--coordinator", f"127.0.0.1:{port}",
+               "--push-weights"]
+    root, t = _run(["generate", *base, *cluster, "--node-rank", "0"])
+    # the worker gets NO --model flag at all — spec and weights arrive
+    # over the broadcast protocol
+    worker, _ = _run(["worker", "--tokenizer", tpath,
+                      "--temperature", "0", "--buffer-float-type", "f32",
+                      *cluster, "--node-rank", "1"])
+    out_root, err_root = root.communicate(timeout=t)
+    out_worker, err_worker = worker.communicate(timeout=t)
+    assert root.returncode == 0, (out_root, err_root)
+    assert worker.returncode == 0, (out_worker, err_worker)
+    assert _gen_line(out_root) == _gen_line(out_single), (
+        out_root, out_single)
+    assert "<pushed>" in out_worker  # the worker really had no file
     assert "root shut down" in out_worker
 
 

@@ -21,6 +21,9 @@ from distributed_llama_tpu.sampler import Sampler
 
 from test_model_forward import make_spec, dense_weights
 
+# compile-heavy SPMD meshes / subprocess clusters: the slow tier (pytest.ini)
+pytestmark = pytest.mark.slow
+
 PROMPT = [3, 9, 1, 4]
 
 

@@ -473,3 +473,108 @@ def test_lookup_sampled_eos_and_budget():
     assert eng3.generate_lookup_sampled(prompt, 0, temperature=0.8,
                                         topp=0.9, seed=9).tokens == []
     assert eng3.pos == len(prompt)
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, 0.0, 0.9, 1, backend="python")
+
+
+def _batch_engine(spec, host, b):
+    params = load_params(spec, host, mode="q40", dtype=jnp.float32)
+    return Engine(spec, params, batch=b, compute_dtype=jnp.float32,
+                  cache_dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("draft_len", [1, 4, 7])
+def test_batch_lookup_matches_per_row_greedy(draft_len):
+    """Batched speculative decoding (VERDICT r4 #7): ragged per-row drafts
+    padded to the widest accept must leave every row's stream EXACTLY its
+    single-engine greedy stream — different prompts, different accept
+    widths per step."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=96)
+    host, _ = dense_weights(spec, seed=41)
+    prompts = [[1, 5, 9, 1, 5], [2, 7], [3, 3, 3, 3], [11, 4, 11, 4, 11]]
+
+    want = [
+        _engine(spec, host).generate(p, 16, _greedy(spec)).tokens
+        for p in prompts
+    ]
+    eng = _batch_engine(spec, host, 4)
+    got = eng.generate_batch_lookup(prompts, 16, draft_len=draft_len)
+    assert got == want, draft_len
+    fwd, n = eng.last_accept_stats
+    assert n == sum(len(w) for w in want)
+
+
+def test_batch_lookup_eos_budget_and_context_edge():
+    """Per-row truncation: one row stops at its eos (included), another is
+    capped by the budget, and rows near the context edge must not corrupt
+    neighbors (drop-mode OOB writes)."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=48)
+    host, _ = dense_weights(spec, seed=43)
+    prompts = [[1, 5, 9, 1, 5], [2, 7, 2, 7]]
+
+    probe = [
+        _engine(spec, host).generate(p, 12, _greedy(spec)).tokens
+        for p in prompts
+    ]
+    eos = probe[0][2]  # row 0 truncates at its 3rd token
+    want = []
+    for p in prompts:
+        want.append(_engine(spec, host).generate(
+            p, 12, _greedy(spec), eos_id=eos).tokens)
+
+    eng = _batch_engine(spec, host, 2)
+    got = eng.generate_batch_lookup(prompts, 12, eos_id=eos, draft_len=5)
+    assert got == want
+
+    # budget cap of 3: every row emits exactly min(3, its full stream)
+    eng2 = _batch_engine(spec, host, 2)
+    got3 = eng2.generate_batch_lookup(prompts, 3, draft_len=5)
+    assert got3 == [w[:3] if len(w) >= 3 else w for w in probe]
+
+    # budget 0: hard-cap contract
+    eng0 = _batch_engine(spec, host, 2)
+    assert eng0.generate_batch_lookup(prompts, 0) == [[], []]
+
+
+def test_batch_lookup_accepts_multiple_tokens_per_forward():
+    """The aggregate-throughput claim: on repetitive rows the batch mode
+    must confirm > 1 token/forward (the whole point — b rows amortize one
+    weight read AND each row advances multiple tokens)."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=160)
+    host, _ = dense_weights(spec, seed=43)
+    eng0 = _engine(spec, host)
+    probe = eng0.generate([2, 7], 96, _greedy(spec)).tokens
+    tail = probe[-24:]
+    if len(set(tail)) > len(tail) - 4:
+        pytest.skip("greedy stream did not become repetitive for this seed")
+
+    eng = _batch_engine(spec, host, 2)
+    out = eng.generate_batch_lookup([[2, 7], [2, 7]], 96, draft_len=7)
+    assert out == [probe, probe]
+    fwd, n = eng.last_accept_stats
+    assert n / fwd > 1.5, (fwd, n)  # tokens per forward, summed over rows
+
+
+def test_batch_lookup_histories_match_single_row_history():
+    """Per-row draft-mining contexts (the bench's fixed-point prime and
+    future prefix-reuse serving): histories[i] must behave exactly like
+    the single-row stream's history= for that row."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=96)
+    host, _ = dense_weights(spec, seed=41)
+    prompts = [[1, 5, 9, 1, 5], [2, 7, 2, 7]]
+    hists = [[3, 4] + p for p in prompts]
+
+    want = []
+    for p, h in zip(prompts, hists):
+        want.append(_engine(spec, host).generate_lookup(
+            p, 12, draft_len=5, history=h).tokens)
+    eng = _batch_engine(spec, host, 2)
+    got = eng.generate_batch_lookup(prompts, 12, draft_len=5,
+                                    histories=hists)
+    assert got == want

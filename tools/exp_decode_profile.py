@@ -1,0 +1,87 @@
+"""Where does the 9.5 ms decode token actually go? (VERDICT r4 #2 scouting)
+
+Traces 32 real 7B Q40 decode steps with jax.profiler and aggregates XLA op
+time by (grouped) op name — separating the Q40 matmul kernels (VPU-bound
+unpack floor) from attention, norms/elementwise fusions, and logits. The
+unpack ceiling argument says the kernel floor is ~8.0 ms (3.79 GB packed
+at ~475 GB/s); this measures how much of the remainder is addressable.
+
+Result (v5e, 2026-07-31, fill 256, 32 steps): see artifacts/ or the
+printed table. Usage: python tools/exp_decode_profile.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import collections
+import dataclasses
+import glob
+import re
+import tempfile
+
+import jax
+import jax.numpy as jnp
+
+from bench import LLAMA2_7B, synth_q40_params, _measure_decode
+from distributed_llama_tpu.runtime import Engine
+
+
+def group(name: str) -> str:
+    """Collapse op names into readable buckets."""
+    n = name.lower()
+    if "custom-call" in n or "mosaic" in n or "tpu_custom_call" in n:
+        return "pallas-kernel"
+    for key in ("fusion", "dynamic-update-slice", "copy", "convert",
+                "reduce", "dot", "transpose", "broadcast", "iota"):
+        if key in n:
+            return key
+    return name.split(".")[0][:32]
+
+
+def main():
+    n_steps = 32
+    spec = dataclasses.replace(LLAMA2_7B, seq_len=2048)
+    params = synth_q40_params(spec)
+    eng = Engine(spec, params, compute_dtype=jnp.bfloat16,
+                 cache_dtype=jnp.bfloat16)
+    ms = _measure_decode(eng, n_steps, 0, 1)  # warm/compile
+    print(f"warm decode: {ms:.3f} ms/token", flush=True)
+
+    trace_dir = tempfile.mkdtemp(prefix="decprof-")
+    with jax.profiler.trace(trace_dir):
+        ms = _measure_decode(eng, n_steps, 256, 1)
+    print(f"traced decode: {ms:.3f} ms/token", flush=True)
+
+    from jax.profiler import ProfileData
+
+    files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
+    pd = ProfileData.from_file(files[-1])
+    per_group = collections.Counter()
+    per_op = collections.Counter()
+    total = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        lines = {ln.name: ln for ln in plane.lines}
+        for ln_name in ("XLA Ops", "Async XLA Ops"):
+            ops = lines.get(ln_name)
+            if ops is None:
+                continue
+            for e in ops.events:
+                ms_e = e.duration_ns / 1e6
+                per_group[group(e.name)] += ms_e
+                per_op[e.name[:80]] += ms_e
+                total += ms_e
+    print(f"\ntotal device op time: {total:.1f} ms over {n_steps} steps "
+          f"= {total / n_steps:.3f} ms/token busy")
+    print("\nby group (ms/token):")
+    for g, v in per_group.most_common(12):
+        print(f"  {g:28s} {v / n_steps:7.3f}")
+    print("\ntop ops (ms/token):")
+    for g, v in per_op.most_common(15):
+        print(f"  {g:78s} {v / n_steps:6.3f}")
+
+
+if __name__ == "__main__":
+    main()

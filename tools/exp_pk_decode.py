@@ -1,0 +1,140 @@
+"""Experiment: 6-op decode unpack via the pk-substitution (VERDICT r4 #2).
+
+The decode kernel's ~7 VPU ops/packed byte (widen, and, shr, cvt x2,
+scale-mul x2) are its measured ceiling (~475 GB/s packed). Substituting
+lo = pk - 16*hi into the contraction:
+
+    y = x_lo·lo·s + x_hi·hi·s
+      = x_lo·(pk·s) + (x_hi - 16·x_lo)·(hi·s)
+
+drops the `& 0xF`: per byte the kernel now does widen, shr, cvt(pk),
+cvt(hi), mul x2 = 6 ops, with the activation combination (x_hi - 16·x_lo)
+hoisted OUTSIDE the kernel (t x M elementwise, free at t=1). The -8 offset
+fold is unchanged.
+
+Result (v5e, 2026-07-31): REJECTED, two independent ways.
+
+1. Speed: FLAT. This standalone harness (DMA-bound, so only a relative
+   signal): base 1.779 vs pk 1.778 ms (w1 shape), 1.745 vs 1.747 (attn
+   shape) — 1.000x. The `& 0xF` co-issues with the loads/converts; it is
+   not on the VPU critical path, so removing it buys nothing.
+2. Precision: 6.4% relative error on the whole q40_matmul (whole-model
+   A/B via DLLAMA_PK_DECODE=1 tripped its parity probe at 6.39e-2). The
+   hoped-for "36x f32 rounding ~ 1e-5" was wrong because DEFAULT-precision
+   dots pass f32 operands through the MXU as bf16: pk in [0,255] consumes
+   the entire bf16 mantissa by itself, and the 16x cancellation amplifies
+   that truncation to percent level. (HIGHEST-precision f32 dots would fix
+   the error but are ~5x slower — pallas_q40.py module docstring.)
+
+Conclusion: the 7-ops/byte decode unpack remains the measured design
+ceiling; with the round-4 negatives (int8 MXU gemv 4x loss, bf16 VPU
+arithmetic slower than f32, prefill-chunk ladder) every VERDICT r4 #2
+candidate is now a recorded negative. (A pk_mode production knob was
+briefly threaded through the kernel for the whole-model A/B and then
+REMOVED — a wrong-output trapdoor has no place in the hot kernel; this
+file is the record.)
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_llama_tpu.ops.pallas_q40 import _f16_bits_to_f32
+from distributed_llama_tpu.quants.numpy_codec import quantize_q40
+
+
+def _kernel(x1_ref, x2_ref, xs_ref, pk_ref, s_ref, o_ref, *, mode):
+    pk = pk_ref[:].astype(jnp.int32)
+    if mode == "base":
+        lo = (pk & 0xF).astype(jnp.float32)
+        hi = (pk >> 4).astype(jnp.float32)
+    else:  # pk-substitution: x1 = x_lo, x2 = x_hi - 16*x_lo
+        lo = pk.astype(jnp.float32)          # actually pk; paired with x1
+        hi = (pk >> 4).astype(jnp.float32)
+    s = _f16_bits_to_f32(s_ref[:].astype(jnp.int32))
+    s16 = pltpu.repeat(s, 16, axis=1)
+    dot = functools.partial(jax.lax.dot_general,
+                            dimension_numbers=(((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    acc = dot(x1_ref[:], lo * s16)
+    acc += dot(x2_ref[:], hi * s16)
+    acc += dot(xs_ref[:], s) * -8.0
+    o_ref[:] = acc
+
+
+def build(mode, d, m, td):
+    nb = m // 16
+
+    @jax.jit
+    def run(x1, x2, xs, pk, s):
+        return pl.pallas_call(
+            functools.partial(_kernel, mode=mode),
+            grid=(d // td,),
+            in_specs=[
+                pl.BlockSpec((1, m), lambda i: (0, 0)),
+                pl.BlockSpec((1, m), lambda i: (0, 0)),
+                pl.BlockSpec((1, nb), lambda i: (0, 0)),
+                pl.BlockSpec((td, m), lambda i: (i, 0)),
+                pl.BlockSpec((td, nb), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, td), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        )(x1, x2, xs, pk, s)
+
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for name, d, n, td in (("w1", 22016, 4096, 256),
+                           ("attn", 4096, 4096, 1024)):
+        m, nb = n // 2, n // 32
+        w = rng.standard_normal((d, n)).astype(np.float32) * 0.05
+        scales, packed = quantize_q40(w)
+        # lane order m = j*nb + b (jax_codec layout)
+        pk = np.asarray(packed).reshape(d, nb, 16).transpose(0, 2, 1).reshape(d, m)
+        su = np.asarray(scales).view(np.uint16).reshape(d, nb)
+        x = rng.standard_normal((1, n)).astype(np.float32)
+        xr = x.reshape(nb, 32)
+        x_lo = xr[:, :16].T.reshape(1, m)   # x_lo[j*nb+b] = x[b*32+j]
+        x_hi = xr[:, 16:].T.reshape(1, m)
+        xs = xr.sum(axis=1).reshape(1, nb)
+
+        a_pk = jnp.asarray(pk)
+        a_s = jnp.asarray(su)
+        args_base = (jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xs),
+                     a_pk, a_s)
+        args_pk = (jnp.asarray(x_lo), jnp.asarray(x_hi - 16.0 * x_lo),
+                   jnp.asarray(xs), a_pk, a_s)
+        fns = {"base": (build("base", d, m, td), args_base),
+               "pk": (build("pk", d, m, td), args_pk)}
+
+        outs = {k: np.asarray(f(*a)) for k, (f, a) in fns.items()}
+        ref = x @ w.T  # true f32 matmul on the QUANTIZED values
+        err = np.abs(outs["pk"] - outs["base"]).max() / (
+            np.abs(outs["base"]).max() + 1e-9)
+        best = {}
+        iters = 64
+        for r in range(6):
+            for k, (f, a) in fns.items():
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    o = f(*a)
+                np.asarray(o)
+                dt = (time.perf_counter() - t0) / iters * 1e3
+                best[k] = dt if k not in best else min(best[k], dt)
+        print(f"{name}: base {best['base']:.3f} ms  pk {best['pk']:.3f} ms  "
+              f"-> {best['base'] / best['pk']:.3f}x  max-rel-err {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()
