@@ -163,10 +163,10 @@ def build_engine(args):
     from ..tokenizer import Tokenizer
 
     multihost = jax.process_count() > 1
+    push = getattr(args, "push_weights", False)
     # root-push mode: only rank 0 needs the .m — workers receive spec +
     # weights over the broadcast protocol (parallel/multihost.py)
-    pushed_worker = (getattr(args, "push_weights", False) and multihost
-                     and jax.process_index() > 0)
+    pushed_worker = push and multihost and jax.process_index() > 0
     if (not args.model and not pushed_worker) or not args.tokenizer:
         sys.exit("error: --model and --tokenizer are required "
                  "(--model optional for --push-weights workers)")
@@ -175,18 +175,29 @@ def build_engine(args):
     if args.weights_float_type:
         wft = FloatType[args.weights_float_type.upper()]
 
-    if pushed_worker:
+    if multihost:
+        # spec broadcast runs on EVERY multihost startup (push or not) so
+        # the collective sequence is flag-independent — a --push-weights
+        # mismatch then reaches check_config as a symmetric error instead
+        # of deadlocking in mismatched collectives (bcast_spec docstring)
         from ..parallel.multihost import bcast_spec
-        spec, model_fp = bcast_spec(None)
+        if jax.process_index() == 0:
+            spec = read_spec(args.model, weights_float_type=wft)
+            model_fp = content_fingerprint(args.model)
+            bcast_spec(spec, model_fp, push=push)
+        else:
+            rspec, rfp, _ = bcast_spec(None)
+            if pushed_worker:
+                spec, model_fp = rspec, rfp
+            else:
+                spec = read_spec(args.model, weights_float_type=wft)
+                model_fp = content_fingerprint(args.model)
     else:
         spec = read_spec(args.model, weights_float_type=wft)
         # sampled content hash of the weights file — folded into the
         # KV-session fingerprint always, and into the cluster config check
         # when multihost
         model_fp = content_fingerprint(args.model)
-        if getattr(args, "push_weights", False) and multihost:
-            from ..parallel.multihost import bcast_spec
-            bcast_spec(spec, model_fp)
     print(f"⏩ {args.model or '<pushed>'}: arch={spec.arch.name} "
           f"dim={spec.dim} layers={spec.n_layers} "
           f"heads={spec.n_heads}/{spec.n_kv_heads} seq={spec.seq_len}")
@@ -230,9 +241,10 @@ def build_engine(args):
                       # own --lookup-decode: a mismatch would diverge the
                       # verify-forward widths and hang a collective
                       args.lookup_decode,
-                      # weight-push is a protocol phase: every process must
-                      # run (or not run) the same broadcast sequence
-                      int(getattr(args, "push_weights", False))])
+                      # weight-push changes the LOAD phase's broadcast
+                      # sequence; reachable because bcast_spec above runs
+                      # flag-independently
+                      int(push)])
 
     mesh = None
     if (args.tp > 1 or args.dp > 1 or args.sp > 1 or args.ep > 1

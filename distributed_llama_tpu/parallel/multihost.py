@@ -194,14 +194,22 @@ def check_config(fingerprint: list[int]) -> None:
             "sampler flags")
 
 
-def bcast_spec(spec, model_fp: int = 0):
-    """Root-push phase 0: rank 0 broadcasts the model spec + weight-content
-    fingerprint so FILE-LESS workers (--push-weights) can participate in
-    the config check and build their engine without ever reading a `.m`.
-    Non-root callers pass spec=None; returns (spec, model_fp) on every
-    rank. Matches the reference root shipping its TransformerSpec struct
-    ahead of the weight push (ref: src/transformer.cpp:633-644) — but as
-    explicit fields, not a raw memcpy."""
+def bcast_spec(spec, model_fp: int = 0, push: bool = False):
+    """Root-push phase 0: rank 0 broadcasts the model spec, weight-content
+    fingerprint, and its --push-weights flag so FILE-LESS workers can
+    participate in the config check and build their engine without ever
+    reading a `.m`. Non-root callers pass spec=None; returns
+    (spec, model_fp, push) on every rank.
+
+    Runs UNCONDITIONALLY on every multihost startup (build_engine), not
+    only in push mode: the collective sequence must be identical across
+    processes regardless of per-process flags, or a --push-weights
+    mismatch would deadlock in mismatched collectives BEFORE check_config
+    could report it. With the sequence fixed, the flag rides here and the
+    fingerprint check turns a mismatch into a symmetric error. Matches the
+    reference root shipping its TransformerSpec struct ahead of the weight
+    push (ref: src/transformer.cpp:633-644) — explicit fields, not a raw
+    memcpy."""
     from ..models.spec import ArchType, HiddenAct, ModelSpec
     from ..quants.types import FloatType
 
@@ -212,9 +220,9 @@ def bcast_spec(spec, model_fp: int = 0):
                   int(np.float32(spec.rope_theta).view(np.int32)),
                   spec.n_experts, spec.n_active_experts,
                   int(spec.weights_float_type), spec.version,
-                  model_fp & 0xFFFFFFFF]
+                  model_fp & 0xFFFFFFFF, int(push)]
     else:
-        fields = [0] * 15
+        fields = [0] * 16
     f = _bcast(np.asarray(fields, np.int64))
     out = ModelSpec(
         arch=ArchType(int(f[0])), dim=int(f[1]), hidden_dim=int(f[2]),
@@ -224,7 +232,7 @@ def bcast_spec(spec, model_fp: int = 0):
         rope_theta=float(np.int32(f[9]).view(np.float32)),
         n_experts=int(f[10]), n_active_experts=int(f[11]),
         weights_float_type=FloatType(int(f[12])), version=int(f[13]))
-    return out, int(f[14])
+    return out, int(f[14]), bool(f[15])
 
 
 def bcast_model_tensors(spec, path: str | None):

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -85,6 +87,7 @@ def test_healthy_run_emits_one_parseable_line():
     assert row["unit"] == "ms/token"
 
 
+@pytest.mark.slow  # full dryrun compile in a subprocess (~100 s)
 def test_dryrun_pins_cpu_before_any_jax_call():
     # dryrun_multichip must succeed with NO ambient cpu pin — the driver's
     # environment lets a sitecustomize hook point jax at the TPU plugin,

@@ -75,11 +75,17 @@ def test_sample_token_topk_window_parity_large_vocab(rng, shape):
     "mixed" alternates — token streams and rng states must stay identical
     either way. (Host-Sampler parity at this vocab is only epsilon-exact:
     the documented f32-vs-f64 CDF deviation — see the peaked host check.)"""
+    import jax
+
     vocab = 4096
     state_fast = state_from_seed(77)
     state_full = state_from_seed(77)
     host = Sampler(vocab, temperature=1.0, topp=0.9, seed=77,
                    backend="python")
+    # jit once — un-jitted sample_token re-traces per draw (~2 s each)
+    fast_fn = jax.jit(lambda l, s: sample_token(l, s, 1.0, 0.9))
+    full_fn = jax.jit(
+        lambda l, s: sample_token(l, s, 1.0, 0.9, _force_full=True))
     host_mismatch = 0
     for i in range(40):
         if shape == "peaked" or (shape == "mixed" and i % 2 == 0):
@@ -89,11 +95,10 @@ def test_sample_token_topk_window_parity_large_vocab(rng, shape):
             # so the window guard must reject and run the full sort
             logits = rng.standard_normal(vocab).astype(np.float32) * 0.01
         x = jnp.asarray(logits)
-        tok, state_fast = sample_token(x, state_fast, 1.0, 0.9)
-        ref, state_full = sample_token(x, state_full, 1.0, 0.9,
-                                       _force_full=True)
+        tok, state_fast = fast_fn(x, state_fast)
+        ref, state_full = full_fn(x, state_full)
         assert int(tok) == int(ref), (shape, i)
-        assert (state_fast == state_full).all()
+        assert (np.asarray(state_fast) == np.asarray(state_full)).all()
         # host stays in rng lock-step; its token may differ only with the
         # ~1% per-draw f32-epsilon odds on near-uniform distributions
         want = host.sample(logits.copy())
@@ -112,15 +117,19 @@ def test_sample_token_topk_window_boundary_fallback(rng):
     vocab = 4096
     # ~100 tokens clearly above the cutoff, the rest far below: n_cand < k
     # while cum(top 100) ≈ 1 > topp — fast path, truncation at cum > topp
+    import jax
+
     logits = np.full(vocab, -12.0, np.float32)
     hot = rng.choice(vocab, size=100, replace=False)
     logits[hot] = rng.standard_normal(100).astype(np.float32)
     host = Sampler(vocab, temperature=0.8, topp=0.95, seed=5,
                    backend="python")
     state = state_from_seed(5)
+    fn = jax.jit(lambda l, s: sample_token(l, s, 0.8, 0.95))
+    x = jnp.asarray(logits)
     for i in range(20):
         want = host.sample(logits.copy())
-        tok, state = sample_token(jnp.asarray(logits), state, 0.8, 0.95)
+        tok, state = fn(x, state)
         assert int(tok) == want, i
 
 

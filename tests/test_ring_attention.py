@@ -7,6 +7,9 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# compile-heavy SPMD meshes: the slow tier (pytest.ini)
+pytestmark = pytest.mark.slow
+
 from distributed_llama_tpu.parallel.mesh import make_mesh
 from distributed_llama_tpu.parallel.ring_attention import ring_attention
 

@@ -560,6 +560,35 @@ def test_batch_lookup_accepts_multiple_tokens_per_forward():
     assert n / fwd > 1.5, (fwd, n)  # tokens per forward, summed over rows
 
 
+def test_batch_lookup_runs_to_context_edge():
+    """Rows actually REACH seq_len (code-review r5: the earlier edge test
+    never did): with a 24-slot cache and an oversized budget, each row
+    must stop exactly where its single-row lookup stream stops, per-row k
+    must clamp at the headroom, and the mixed-fill rows must not corrupt
+    each other (the scatter's drop-mode OOB writes the padding relies
+    on)."""
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=128, seq_len=24)
+    host, _ = dense_weights(spec, seed=41)
+    prompts = [[1, 5, 9, 1, 5], [2, 7]]  # ragged: row 1 has more headroom
+
+    want = []
+    for p in prompts:
+        want.append(_engine(spec, host).generate_lookup(
+            p, 64, draft_len=7).tokens)
+    # sanity: the budget is NOT the binding constraint — the cache is
+    # (the final emitted token is never stepped, so a stream can carry one
+    # token past the last written slot — generate() parity)
+    assert all(len(p) + len(w) <= spec.seq_len + 1
+               for p, w in zip(prompts, want))
+    assert any(len(p) + len(w) >= spec.seq_len - 1
+               for p, w in zip(prompts, want))
+
+    eng = _batch_engine(spec, host, 2)
+    got = eng.generate_batch_lookup(prompts, 64, draft_len=7)
+    assert got == want
+
+
 def test_batch_lookup_histories_match_single_row_history():
     """Per-row draft-mining contexts (the bench's fixed-point prime and
     future prefix-reuse serving): histories[i] must behave exactly like
