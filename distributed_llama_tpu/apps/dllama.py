@@ -370,10 +370,18 @@ def cmd_generate(args, benchmark: bool) -> None:
         sys.exit("error: --device-sampling does not compose with "
                  "--nnodes (the worker protocol drives generate())")
     if args.lookup_decode:
-        if args.dp > 1 or args.device_sampling:
-            sys.exit("error: --lookup-decode is single-sequence host-loop "
-                     "decoding; it does not compose with --dp/"
-                     "--device-sampling")
+        if args.device_sampling:
+            sys.exit("error: --lookup-decode is host-loop decoding; it "
+                     "does not compose with --device-sampling")
+        if args.dp > 1 and args.temperature != 0:
+            sys.exit("error: --lookup-decode with --dp is greedy-only "
+                     "(Engine.generate_batch_lookup); set --temperature 0")
+        if args.dp > 1 and args.nnodes > 1:
+            # the worker protocol's lookup replay is single-row
+            # (cmd_worker -> generate_lookup); a batched root would run a
+            # different forward program and hang the cluster
+            sys.exit("error: --lookup-decode with --dp does not compose "
+                     "with --nnodes")
     engine, tokenizer, sampler = build_engine(args)
     prompt = args.prompt or "Hello"
     tokens = tokenizer.encode(prompt)
@@ -383,7 +391,17 @@ def cmd_generate(args, benchmark: bool) -> None:
         # dp throughput mode: the batch rows generate independently (here the
         # same prompt replicated); row 0 streams to stdout
         t0 = time.time()
-        if args.device_sampling:
+        if args.lookup_decode:
+            # batched speculation (round 5): per-row drafts, one verify
+            # forward per step, exact per-row greedy parity
+            _announce_run(tokens, _steps(args, engine), sampler=sampler,
+                          lookup=args.lookup_decode)
+            outs = engine.generate_batch_lookup(
+                [tokens] * engine.batch, _steps(args, engine),
+                eos_id=tokenizer.stop_token_ids(),
+                draft_len=args.lookup_decode,
+                vocab_size=tokenizer.vocab_size)
+        elif args.device_sampling:
             with _maybe_profile(args):
                 outs = engine.generate_batch_device(
                     [tokens] * engine.batch, _steps(args, engine),

@@ -949,6 +949,7 @@ class Engine:
         max_ngram: int = 3,
         vocab_size: int | None = None,
         histories: list[list[int]] | None = None,
+        stop_flags: np.ndarray | None = None,
     ) -> list[list[int]]:
         """Batched prompt-lookup speculative decoding (VERDICT r4 #7):
         every row mines its own draft from its own history each step, the
@@ -965,7 +966,11 @@ class Engine:
         (stop token included — generate() parity). `last_accept_stats`
         holds (verify_forwards, total_tokens) summed over live rows.
         `histories[i]` (defaults to prompts[i]) seeds row i's draft-mining
-        context, like the single-row stream's `history`."""
+        context, like the single-row stream's `history`. `stop_flags` rows
+        set True BEFORE the call never emit (the API server pads sub-batch
+        requests up to the engine's fixed batch with such rows); unlike
+        generate_batch_stream's live flags, they are read once at start —
+        text-level stops apply post-hoc on the collected rows."""
         from .speculative import count_accepted, find_draft
 
         b = len(prompts)
@@ -1009,15 +1014,18 @@ class Engine:
         out: list[list[int]] = [[] for _ in range(b)]
         hists: list[np.ndarray] = []
         cur = np.zeros(b, np.int32)
-        done = np.zeros(b, bool)
+        done = (np.asarray(stop_flags, bool).copy() if stop_flags is not None
+                else np.zeros(b, bool))
         pos = lens.copy()
         for i in range(b):
-            tok_i = int(first_np[i])
-            out[i].append(tok_i)
-            cur[i] = tok_i
+            cur[i] = int(first_np[i])
             hists.append(np.asarray(
                 (histories[i] if histories is not None else prompts[i])
-                + [tok_i], np.int32))
+                + [int(first_np[i])], np.int32))
+            if done[i]:
+                continue  # pre-retired padding row: never emits
+            tok_i = int(first_np[i])
+            out[i].append(tok_i)
             if tok_i in stop_ids:
                 done[i] = True
         self.pos = int(pos.max())
