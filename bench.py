@@ -28,9 +28,11 @@ and this avoids materializing 28 GB of f32 on the host. The decode path is
 the production one: Engine.decode_greedy_device (fully on-device lax.scan,
 fused argmax, donated KV cache).
 
-Env knobs: BENCH_MODEL=7b|8b|13b|moe|tiny (8b = Llama-3-8B GQA/128k-vocab,
-judged against the reference's best 1-node 8B number; 13b vs its 13B GCP
-row; moe = the Mixtral-shaped config below), BENCH_TOKENS=<n decode steps>,
+Env knobs: BENCH_MODEL=7b|8b|13b|moe|grok|70bt|tiny (8b = Llama-3-8B
+GQA/128k-vocab, judged against the reference's best 1-node 8B number; 13b
+vs its 13B GCP row; moe/grok = the production-width MoE configs below;
+70bt = Llama-2-70B widths truncated to 4 layers — the per-layer cost of
+the north-star shape on one chip), BENCH_TOKENS=<n decode steps>,
 BENCH_SEQ/BENCH_FILL for long-context variants, BENCH_CACHE=f8 for the fp8
 KV cache, BENCH_VARIANTS=0 to skip the extra rows.
 """
@@ -83,6 +85,15 @@ MIXTRAL_MOE = ModelSpec(  # Mixtral 8x7B production dims, truncated to 4
     n_heads=32, n_kv_heads=8, vocab_size=32000, seq_len=2048,
     hidden_act=HiddenAct.SILU, rope_theta=1000000.0,
     n_experts=8, n_active_experts=2)
+
+LLAMA2_70B_TRUNC = ModelSpec(  # Llama-2-70B PRODUCTION widths (dim 8192,
+    # hidden 28672, GQA 64/8 — the north-star model), truncated to 4
+    # layers (~2.4 GB packed + embeddings): measures the per-layer decode
+    # cost of the 70B SHAPE on real silicon, so the v5e-16 projection
+    # (README) rests on a measured per-layer number, not the 7B's
+    arch=ArchType.LLAMA, dim=8192, hidden_dim=28672, n_layers=4,
+    n_heads=64, n_kv_heads=8, vocab_size=32000, seq_len=2048,
+    hidden_act=HiddenAct.SILU)
 
 GROK1_TRUNC = ModelSpec(  # Grok-1 PRODUCTION widths (dim 6144, 8 experts
     # of hidden 32768, GQA 48/8, 131k vocab, GELU, the 4-norm block —
@@ -565,7 +576,8 @@ def main() -> None:
     # <0.3 ms/token and attention runs at realistic steady-state fill
     n_tokens = int(os.environ.get("BENCH_TOKENS", "512"))
     spec = {"7b": LLAMA2_7B, "8b": LLAMA3_8B, "13b": LLAMA2_13B,
-            "moe": MIXTRAL_MOE, "grok": GROK1_TRUNC}.get(model, TINY)
+            "moe": MIXTRAL_MOE, "grok": GROK1_TRUNC,
+            "70bt": LLAMA2_70B_TRUNC}.get(model, TINY)
     # long-context variants: BENCH_SEQ widens the cache, BENCH_FILL starts
     # decode at a deep fill (the flash kernel reads ~fill bytes of cache)
     seq = int(os.environ.get("BENCH_SEQ", str(min(spec.seq_len, 2048))))
@@ -583,7 +595,8 @@ def main() -> None:
               "8b": "llama3_8b_q40_decode_ms_per_token_1chip",
               "13b": "llama2_13b_q40_decode_ms_per_token_1chip",
               "moe": "mixtral_moe_q40_decode_ms_per_token_1chip",
-              "grok": "grok1_fullwidth_q40_decode_ms_per_token_1chip"}.get(
+              "grok": "grok1_fullwidth_q40_decode_ms_per_token_1chip",
+              "70bt": "llama2_70b_width_q40_decode_ms_per_token_1chip"}.get(
         model, "tiny_llama_q40_decode_ms_per_token")
     base = {"7b": BASELINE_MS_PER_TOKEN,
             "8b": BASELINE_8B_MS_PER_TOKEN,
@@ -619,6 +632,14 @@ def main() -> None:
                                n_tokens=n_tokens,
                                cache_itemsize=jnp.dtype(cache_dtype).itemsize,
                                base=base))
+        if model in ("moe", "grok", "70bt"):
+            # truncated-depth configs: the per-layer cost is the number
+            # that extrapolates to full depth (includes the shared
+            # wcls/embedding read spread over the resident layers — the
+            # true per-layer weight read is slightly lower; full-depth
+            # runs amortize the head further)
+            out["ms_per_token_per_layer"] = round(
+                ms_per_token / spec.n_layers, 4)
         print(json.dumps(out), file=sys.stderr, flush=True)
         if os.environ.get("BENCH_SIMULATE_OUTAGE"):  # test hook
             raise RuntimeError("simulated mid-run outage")
