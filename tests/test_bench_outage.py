@@ -104,3 +104,43 @@ def test_dryrun_pins_cpu_before_any_jax_call():
                        text=True, timeout=600.0, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "DRYRUN_OK" in r.stdout
+
+
+def test_sigterm_flushes_partial_json():
+    """A driver-side `timeout` delivers SIGTERM mid-run; bench must flush
+    the accumulated JSON line (partial rows kept) and exit 0 instead of
+    dying silently — a ~25-min variant ladder must never lose its
+    already-measured main row to a deadline."""
+    import signal
+    import time
+
+    env = dict(os.environ)
+    env.update({
+        "BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+        "BENCH_MODEL": "tiny", "BENCH_TOKENS": "200",
+        "BENCH_REPEATS": "200", "BENCH_VARIANTS": "0",
+        "BENCH_PROBE_TIMEOUT": "30",  # don't let the probe eat the window
+    })
+    p = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+                         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                         text=True, env=env, cwd=REPO)
+    try:
+        time.sleep(18)  # past compile, mid-measurement (typical machines)
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+    finally:
+        if p.poll() is None:  # never leak a decode-looping child
+            p.kill()
+            p.communicate(timeout=30)
+    if p.returncode == -signal.SIGTERM:
+        # the signal landed during module imports, before main() could
+        # install the handler — an environment too slow for this probe,
+        # not a product failure
+        pytest.skip("SIGTERM landed before bench.py main() started")
+    assert p.returncode == 0
+    lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+    assert lines, out
+    row = json.loads(lines[-1])
+    # either the handler fired mid-run (error annotated) or the run beat
+    # the signal (fast machine) — both must yield one parseable line
+    assert "terminated" in row.get("error", "") or row.get("value")

@@ -104,3 +104,37 @@ def test_model_file_reader_rejects_garbage(tmp_path, rng):
     with pytest.raises((ValueError, AssertionError, struct.error,
                         EOFError, OSError, KeyError)):
         read_spec(path)
+
+
+def test_fuzz_batch_lookup_parity(rng):
+    """Property fuzz (round 5): random ragged prompt batches + random
+    draft lengths — generate_batch_lookup must equal per-row single-engine
+    greedy streams on every draw (accept/reject paths, eos-free)."""
+    from distributed_llama_tpu.models import ArchType
+    from distributed_llama_tpu.sampler import Sampler
+
+    from test_model_forward import make_spec, dense_weights
+    from test_speculative import _batch_engine, _engine
+
+    spec = make_spec(ArchType.LLAMA, dim=64, n_heads=8, n_kv_heads=4,
+                     vocab_size=96, seq_len=80)
+    host, _ = dense_weights(spec, seed=57)
+
+    for trial in range(4):
+        b = int(rng.integers(2, 5))
+        draft = int(rng.integers(1, 8))
+        n = int(rng.integers(3, 14))
+        prompts = [
+            rng.integers(1, spec.vocab_size,
+                         int(rng.integers(1, 9))).tolist()
+            for _ in range(b)
+        ]
+        want = [
+            _engine(spec, host).generate(
+                p, n, Sampler(spec.vocab_size, 0.0, 0.9, 1,
+                              backend="python")).tokens
+            for p in prompts
+        ]
+        got = _batch_engine(spec, host, b).generate_batch_lookup(
+            prompts, n, draft_len=draft)
+        assert got == want, (trial, b, draft, n, prompts)
