@@ -492,3 +492,18 @@ def test_cli_dp_lookup_matches_plain(tmp_path, rng, capsys):
     plain = run(base + ["--dp", "2"])
     spec = run(base + ["--dp", "2", "--lookup-decode", "4"])
     assert plain == spec == single
+
+
+@pytest.mark.parametrize("wt", [FloatType.F32, FloatType.Q80])
+def test_cli_runs_f32_and_q80_weight_files(tmp_path, rng, capsys, wt):
+    """The reference converts/serves q40, q80 AND f32 weight files
+    (ref: converter/writer.py); q40 has dedicated kernels here, while q80/
+    f32 run through the dense load path — pin that both actually DECODE
+    (inference mode's stats line counts the generated tokens, so a load
+    path that serves but silently emits nothing fails here)."""
+    mpath, tpath = _fixture(tmp_path, rng, wt=wt)
+    dllama.main(["inference", "--model", mpath, "--tokenizer", tpath,
+                 "--prompt", "ab", "--steps", "4", "--seed", "7",
+                 "--temperature", "0"])
+    out = capsys.readouterr().out
+    assert "Generated tokens:    4" in out, wt
