@@ -42,11 +42,15 @@ def quantize_q40(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 def dequantize_q40(scales: np.ndarray, packed: np.ndarray) -> np.ndarray:
     """Inverse of quantize_q40 per the engine decoder (ref: src/quants.cpp:166-179):
     value j in [0,16) = (lo nibble - 8) * d, value j+16 = (hi nibble - 8) * d.
-    """
+
+    Arbitrary file bytes can carry NaN/inf f16 scale patterns (fuzz /
+    malformed models); they propagate into the values exactly like the
+    reference's f16 LUT lookup would, without a numpy warning."""
     lo = (packed & 0xF).astype(np.int8) - 8
     hi = (packed >> 4).astype(np.int8) - 8
     vals = np.concatenate([lo, hi], axis=-1).astype(np.float32)
-    out = vals * scales[..., None].astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        out = vals * scales[..., None].astype(np.float32)
     return out.reshape(*out.shape[:-2], -1)
 
 
@@ -66,8 +70,11 @@ def quantize_q80(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def dequantize_q80(scales: np.ndarray, q: np.ndarray) -> np.ndarray:
-    """(ref: src/quants.cpp:266-284)"""
-    out = q.astype(np.float32) * scales[..., None].astype(np.float32)
+    """(ref: src/quants.cpp:266-284). NaN/inf scale bit patterns from
+    arbitrary file bytes propagate warning-free, same contract as
+    dequantize_q40."""
+    with np.errstate(invalid="ignore"):
+        out = q.astype(np.float32) * scales[..., None].astype(np.float32)
     return out.reshape(*out.shape[:-2], -1)
 
 
