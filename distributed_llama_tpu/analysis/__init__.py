@@ -1,0 +1,20 @@
+"""dlgrind: JAX-aware static analysis for the TPU port.
+
+Two levels (see docs/analysis.md for the rule catalogue):
+
+  * Level 1 — AST lint over the package source (ast_lint.py, no JAX
+    import): host syncs / numpy calls / Python control flow on traced
+    values, implicit-dtype literals in kernels, missing donate_argnums,
+    leftover debug output.
+  * Level 2 — jaxpr audit of the public jitted entry points
+    (jaxpr_audit.py + entrypoints.py): host-callback primitives, f64
+    promotion under x64 tracing, full-precision activation re-replication,
+    signature-fingerprint drift.
+
+Run `python -m distributed_llama_tpu.analysis --check` (the CI gate), or
+let pytest collect the same gate via tests/test_analysis.py. Accepted
+findings live in analysis/baseline.json; suppress single lines with
+`# dlgrind: ignore[RULE]`.
+"""
+
+from .findings import Finding  # noqa: F401

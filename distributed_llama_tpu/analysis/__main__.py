@@ -1,0 +1,117 @@
+"""CLI: python -m distributed_llama_tpu.analysis [--check] [--format=...]
+
+Exit codes: without --check, always 0 unless the analyzer itself fails
+(report mode — safe in `set -e` scripts); with --check, 1 when findings
+beyond the baseline exist (the CI gate); 2 = analyzer failure.
+
+--no-jaxpr skips Level 2 so the lint runs without importing JAX at all
+(pre-commit hooks, bare environments). The CI job runs the full analyzer
+on JAX_PLATFORMS=cpu with 8 virtual devices (entrypoints.py needs a mesh
+for the tp/ep entries).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .ast_lint import lint_package
+from .findings import (format_github, format_json, format_text,
+                       load_baseline, sort_findings, split_by_baseline,
+                       write_baseline)
+
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def run(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_llama_tpu.analysis",
+        description="dlgrind: JAX-aware static analysis (AST lint + "
+                    "jaxpr audit)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on findings not in the baseline (CI gate)")
+    ap.add_argument("--format", choices=("text", "github", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept the current findings/fingerprints as the "
+                         "new baseline")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="AST lint only (no JAX import)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print findings the baseline accepts")
+    args = ap.parse_args(argv)
+
+    try:
+        findings = lint_package(PKG_DIR, prefix="distributed_llama_tpu/")
+    except SyntaxError as e:
+        print(f"analyzer failed to parse source: {e}", file=sys.stderr)
+        return 2
+
+    baseline = load_baseline(args.baseline)
+    fingerprints: dict[str, str] = dict(baseline.get("fingerprints", {}))
+
+    if not args.no_jaxpr:
+        # the virtual mesh must be configured before jax initializes —
+        # same convention as tests/conftest.py so the tp/ep entries exist
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ..utils.virtual_mesh import ensure_virtual_cpu_devices
+
+        ensure_virtual_cpu_devices()
+        try:
+            from .jaxpr_audit import audit_all
+
+            jaxpr_findings, fingerprints = audit_all(
+                baseline.get("fingerprints", {}))
+        except Exception as e:  # analyzer crash, NOT a gate failure —
+            # keep exit code 2 distinguishable from "new findings" (1)
+            print(f"jaxpr audit failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        findings.extend(jaxpr_findings)
+
+    new, accepted = split_by_baseline(findings, baseline)
+
+    if args.update_baseline:
+        # a short mesh cannot produce a trustworthy baseline: the tp/ep
+        # entries were never audited, and pinning their DLG200 findings
+        # (or dropping their fingerprints) would defeat the vacuous-pass
+        # guard permanently
+        if any(f.rule == "DLG200" for f in findings):
+            print("refusing --update-baseline: some entry points were not "
+                  "audited (DLG200) — rerun with the full virtual mesh "
+                  "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+            return 2
+        # DLG204 drift findings embed the old->new hashes in their message
+        # — as allowlist keys they could never match again. Fingerprints
+        # are re-pinned via their own map; keep them out of the findings.
+        pinned = [f for f in findings if f.rule != "DLG204"]
+        write_baseline(args.baseline, pinned, fingerprints)
+        print(f"baseline updated: {len(pinned)} finding(s), "
+              f"{len(fingerprints)} fingerprint(s) -> {args.baseline}")
+        return 0
+
+    to_show = sort_findings(new)
+    if args.format == "github":
+        out = format_github(to_show)
+    elif args.format == "json":
+        out = format_json(to_show)
+    else:
+        out = format_text(to_show, accepted=len(accepted))
+        if args.show_baselined and accepted:
+            out += "\n-- baselined --\n" + format_text(
+                sort_findings(accepted))
+    if out:
+        print(out)
+
+    if new and args.check:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

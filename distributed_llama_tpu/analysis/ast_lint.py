@@ -1,0 +1,688 @@
+"""Level 1: JAX-aware AST lint over the package's own source (no JAX import).
+
+The reference C++ engine makes every host sync, dtype, and shard boundary
+explicit in its task list; the JAX port hides them behind tracing, where a
+stray `np.asarray` on a traced value or a Python `if` on a traced boolean
+compiles silently (as a baked-in constant or a ConcretizationError at the
+worst possible moment) and surfaces only as a perf cliff on real TPUs.
+These rules encode the port's tracing discipline:
+
+  DLG101  host sync inside a traced context (.item()/float()/np.asarray/
+          jax.device_get/.tolist()/.block_until_ready on a traced value)
+  DLG102  numpy call on a value that flows from a traced function param
+  DLG103  Python if/while/assert on a traced boolean
+  DLG104  implicit-dtype bare float literal in an ops kernel (0.5 vs
+          jnp.float32(0.5)) — promotion bait once x64 or a wider dtype
+          appears on the other side
+  DLG105  jax.jit of a cache-carrying step in runtime/engine.py without
+          donate_argnums (decode would realloc the KV cache every token)
+  DLG106  leftover jax.debug.* / print() in ops/ or parallel/
+  DLG107  host-device boundary sync in runtime/quants host code
+          (np.asarray / int() / .block_until_ready on a device value) —
+          every one is either a deliberate, baselined boundary (sampler
+          input, stats) or a hidden stall
+
+Taint model: within a traced function, parameters are traced values unless
+their annotation, default, or name marks them static (ModelSpec, meshes,
+flags). Assignments propagate taint; static attributes (.shape/.ndim/
+.dtype/...), len(), isinstance() and `is None` tests break it. The model
+is intraprocedural and one-pass — false negatives are acceptable, false
+positives are not (every rule has a clean-fixture test).
+
+A function is a traced context when it is decorated with/passed to
+jax.jit, shard_map, lax.scan/while_loop/cond/vmap — or when it lives in a
+kernel module (ops/, parallel/ compute files, models/transformer.py,
+quants/jax_codec.py), where all array-taking code is traced by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding, is_suppressed, parse_suppressions
+
+# modules where every top-level function is a traced context
+KERNEL_MODULES = (
+    "ops/",
+    "models/transformer.py",
+    "quants/jax_codec.py",
+    "parallel/collectives.py",
+    "parallel/ep_moe.py",
+    "parallel/pp.py",
+    "parallel/ring_attention.py",
+    "parallel/tp_q80.py",
+)
+# DLG104 scope: hand-written kernels where literal dtype discipline matters
+OPS_MODULES = ("ops/",)
+# DLG106 scope
+DEBUG_BAN_MODULES = ("ops/", "parallel/")
+# DLG105 scope
+DONATE_MODULES = ("runtime/engine.py",)
+# DLG107 scope: host-side runtime code that touches device values
+HOST_SYNC_MODULES = ("runtime/", "quants/", "sampler.py")
+
+# attribute reads that yield static (trace-time) values — access breaks taint
+STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "weak_type", "sharding",
+    "is_fully_replicated", "nbytes", "files",
+}
+# calls whose result is static regardless of argument taint
+STATIC_CALLS = {"len", "isinstance", "hasattr", "type", "range", "enumerate"}
+# codebase shape/precondition predicates: they read only .shape/.dtype of
+# their operands, so their result is trace-time static (kept in sync with
+# the code — a new predicate that reads VALUES must not be added here)
+SHAPE_PREDICATES = {"supports_pallas", "gpipe_microbatches", "_flash_ok",
+                    "_n_sub"}
+# annotations marking a parameter as static configuration, not data
+STATIC_ANNOTATIONS = {
+    "ModelSpec", "HiddenAct", "ArchType", "Mesh", "PartitionSpec", "P",
+    "str", "bool", "int", "float", "Sampler", "Callable", "FloatType",
+}
+# annotations marking a parameter as a HOST array (numpy) — never traced
+HOST_ANNOTATIONS = {"np.ndarray", "numpy.ndarray"}
+# annotations marking a parameter as a DEVICE array for DLG107 host tracking
+DEVICE_ANNOTATIONS = {"jax.Array", "jnp.ndarray", "jax.numpy.ndarray",
+                      "KVCache"}
+# parameter names that are static config by convention in kernel modules
+STATIC_NAMES = {
+    "mesh", "spec", "cfg", "act", "arch", "axis", "axis_name", "block",
+    "tp", "sp", "ep", "pp", "dp", "n", "theta", "act_fn", "dtype",
+    "reduce", "head_size", "draft_len", "max_ngram", "min_ngram", "n_mb",
+}
+# calls that hand a function to the tracer: any local function referenced
+# as an argument becomes a traced context
+TRACING_CALLS = {
+    "jit", "scan", "while_loop", "cond", "shard_map", "vmap", "pmap",
+    "checkpoint", "remat", "make_jaxpr", "eval_shape", "switch",
+    "pallas_call", "fori_loop",
+}
+# host-sync sinks shared by DLG101 (traced ctx) and DLG107 (host ctx)
+NUMPY_SYNC_FUNCS = {"asarray", "array", "float32", "float64", "int32",
+                    "int64", "copy", "ascontiguousarray"}
+BUILTIN_SYNC_FUNCS = {"float", "int", "bool"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "__array__"}
+# calls whose RESULT is host data (they break device taint in DLG107 —
+# the sync itself is the finding; downstream host math is fine)
+HOST_RESULT_CALLS = {"fetch_logits"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _ann_name(ann: ast.AST | None) -> str:
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split("|")[0].strip()
+    if isinstance(ann, ast.BinOp):  # "X | None" unions
+        return _ann_name(ann.left)
+    if isinstance(ann, ast.Subscript):
+        return _ann_name(ann.value)
+    return _dotted(ann)
+
+
+def _is_static_const(node: ast.AST | None) -> bool:
+    return isinstance(node, ast.Constant) or (
+        isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                     ast.Constant))
+
+
+class _Taint:
+    """Name-set taint with static-aware expression queries."""
+
+    def __init__(self, names: set[str]):
+        self.names = set(names)
+
+    def expr(self, node: ast.AST | None) -> bool:
+        """Does evaluating `node` produce a (possibly) traced/device value?"""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            leaf = fn.rsplit(".", 1)[-1]
+            if leaf in STATIC_CALLS or leaf in SHAPE_PREDICATES:
+                return False
+            if leaf == "getattr" and len(node.args) >= 2 and (
+                    isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value in STATIC_ATTRS):
+                return False
+            if leaf in HOST_RESULT_CALLS or fn.startswith(("np.", "numpy.")):
+                return False  # result is host data (the call site itself is
+                # judged separately as a sync sink)
+            if fn in BUILTIN_SYNC_FUNCS or (
+                    isinstance(node.func, ast.Attribute)
+                    and leaf in SYNC_METHODS):
+                return False  # int(x)/x.item()/x.tolist() SYNC — flagged as
+                # a sink once; their result is a plain host value
+            # method call on a tainted object, or any tainted argument,
+            # or a call THROUGH a tainted callable (a jitted step handle)
+            return (self.expr(node.func)
+                    or any(self.expr(a) for a in node.args)
+                    or any(self.expr(k.value) for k in node.keywords))
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` yields a static bool under trace
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            # membership against string constants is pytree-structure /
+            # config logic, not array math: `'wqkv' in lw`, `role in
+            # ('row', 'col')`
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                def _const_ish(n):
+                    if isinstance(n, ast.Constant):
+                        return True
+                    return isinstance(n, (ast.Tuple, ast.List, ast.Set)) and \
+                        all(isinstance(e, ast.Constant) for e in n.elts)
+                if _const_ish(node.left) or all(_const_ish(c)
+                                                for c in node.comparators):
+                    return False
+            return self.expr(node.left) or any(self.expr(c)
+                                               for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.expr(node.elt)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        return False
+
+    def assign(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.names.add if tainted else self.names.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.assign(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, tainted)
+        # attribute/subscript targets (self.x = ...) are not tracked
+
+
+def _stmt_exprs(stmt):
+    """Expressions belonging to this statement alone — nested statement
+    blocks (body/orelse/finalbody/handlers) are excluded; their statements
+    are visited in their own turn with up-to-date taint."""
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        for v in (value if isinstance(value, list) else [value]):
+            if isinstance(v, ast.AST):
+                yield from ast.walk(v)
+
+
+def _collect_traced_fn_names(tree: ast.Module) -> set[str]:
+    """Names of locally-defined functions handed to a tracing call anywhere
+    in the module (jax.jit(run), lax.while_loop(cond, body), ...)."""
+    defined: set[str] = {n.name for n in ast.walk(tree)
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))}
+    traced: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _dotted(node.func).rsplit(".", 1)[-1]
+        if leaf not in TRACING_CALLS:
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in defined:
+                traced.add(arg.id)
+    return traced
+
+
+def _has_jit_decorator(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = _dotted(d).rsplit(".", 1)[-1]
+        if name in TRACING_CALLS:
+            return True
+        if name == "partial" and isinstance(dec, ast.Call) and dec.args:
+            inner = _dotted(dec.args[0]).rsplit(".", 1)[-1]
+            if inner in TRACING_CALLS:
+                return True
+    return False
+
+
+def _param_entries(fn) -> list[tuple[str, ast.AST | None, ast.AST | None]]:
+    """(name, annotation, default) for every parameter."""
+    a = fn.args
+    out = []
+    pos = a.posonlyargs + a.args
+    defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+    for arg, d in zip(pos, defaults):
+        out.append((arg.arg, arg.annotation, d))
+    for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+        out.append((arg.arg, arg.annotation, d))
+    if a.vararg:
+        out.append((a.vararg.arg, a.vararg.annotation, None))
+    if a.kwarg:
+        out.append((a.kwarg.arg, a.kwarg.annotation, None))
+    return out
+
+
+def _traced_params(fn) -> set[str]:
+    """Parameters treated as traced values in a traced context.
+
+    Keyword-only parameters are static by codebase convention: jax
+    transforms (donate/static argnums, vmap axes) address operands
+    positionally, so traced arrays ride positional slots and `*`-section
+    params carry configuration (forward()'s flag block, the pallas
+    kernels' scales_u16/mxu_bf16)."""
+    out = set()
+    a = fn.args
+    kwonly = {arg.arg for arg in a.kwonlyargs}
+    for name, ann, default in _param_entries(fn):
+        if name == "self" or name in kwonly:
+            continue
+        ann_name = _ann_name(ann)
+        ann_leaf = ann_name.rsplit(".", 1)[-1]
+        if ann_name in HOST_ANNOTATIONS:
+            continue
+        if ann_leaf in STATIC_ANNOTATIONS:
+            continue
+        if name in STATIC_NAMES or name.endswith(("_mesh", "_dtype",
+                                                  "_fn", "_name")):
+            continue
+        if _is_static_const(default):  # flag/config params default to
+            continue                   # literals (False, "exact", 7, None)
+        out.add(name)
+    return out
+
+
+def _device_params(fn) -> set[str]:
+    """Parameters explicitly annotated as device arrays (DLG107 sources).
+    Exact annotation match only: `np.ndarray` must NOT leaf-match
+    `jnp.ndarray` — host numpy params are never device values."""
+    return {name for name, ann, _ in _param_entries(fn)
+            if _ann_name(ann) in DEVICE_ANNOTATIONS}
+
+
+class ModuleLinter:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=relpath)
+        self.findings: list[Finding] = []
+        self.traced_names = _collect_traced_fn_names(self.tree)
+        # scope rules match package-relative paths whether the caller passed
+        # "ops/norms.py" or "distributed_llama_tpu/ops/norms.py"
+        scope = relpath.split("distributed_llama_tpu/", 1)[-1]
+        self.is_kernel = scope.startswith(KERNEL_MODULES)
+        self.in_ops = scope.startswith(OPS_MODULES)
+        self.ban_debug = scope.startswith(DEBUG_BAN_MODULES)
+        self.check_donate = any(scope.endswith(m) for m in DONATE_MODULES)
+        self.host_sync = scope.startswith(HOST_SYNC_MODULES)
+
+    def add(self, rule: str, severity: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(rule, severity, self.relpath,
+                                     getattr(node, "lineno", 0), msg))
+
+    def run(self) -> list[Finding]:
+        if self.ban_debug:
+            self._lint_debug_leftovers()
+        for fn in self._functions(self.tree):
+            if self._is_traced(fn):
+                self._lint_traced_fn(fn)
+            elif self.host_sync:
+                self._lint_host_fn(fn)
+            if self.check_donate:
+                self._lint_donate(fn)
+        supp = parse_suppressions(self.source)
+        out, seen = [], set()
+        for f in self.findings:
+            if is_suppressed(f, supp):
+                continue
+            # one finding per (rule, line): a sync nested in a sync (e.g.
+            # `int(min(..., int(n)))`) is one boundary crossing to fix
+            if (f.rule, f.line) in seen:
+                continue
+            seen.add((f.rule, f.line))
+            out.append(f)
+        return out
+
+    # -- helpers ----------------------------------------------------------
+
+    def _functions(self, root) -> list[ast.FunctionDef]:
+        return [n for n in ast.walk(root)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _is_traced(self, fn) -> bool:
+        if _has_jit_decorator(fn) or fn.name in self.traced_names:
+            return True
+        return self.is_kernel and not fn.name.startswith("host_")
+
+    # -- DLG106: leftover debug output ------------------------------------
+
+    def _lint_debug_leftovers(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            if fn.startswith("jax.debug.") or fn.startswith("debug."):
+                self.add("DLG106", "error", node,
+                         f"leftover `{fn}` in kernel code — remove before "
+                         "merge (host callback per step on TPU)")
+            elif fn == "print":
+                self.add("DLG106", "error", node,
+                         "leftover `print()` in kernel code — it runs at "
+                         "trace time (or as a host callback) on TPU")
+
+    # -- DLG105: donate_argnums on cache-carrying jits ---------------------
+
+    def _lint_donate(self, fn) -> None:
+        """Flag jax.jit(step_fn) where step_fn takes a `cache` param but the
+        jit call passes no donate_argnums — decode would copy the KV cache
+        every token instead of updating in place."""
+        local_defs = {f.name: f for f in self._functions(fn)}
+
+        def wrapped_params(callee) -> list[str]:
+            if isinstance(callee, ast.Name) and callee.id in local_defs:
+                return [p for p, _, _ in _param_entries(local_defs[callee.id])]
+            if isinstance(callee, ast.Lambda):
+                return [a.arg for a in callee.args.args]
+            return []
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf != "jit" or not node.args:
+                continue
+            params = wrapped_params(node.args[0])
+            if "cache" not in params:
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            if "donate_argnums" not in kwargs and "donate_argnames" not in kwargs:
+                self.add("DLG105", "warning", node,
+                         "jax.jit of a cache-carrying step without "
+                         "donate_argnums — the KV cache update copies "
+                         "instead of aliasing (per-token realloc)")
+
+        # decorator form: @partial(jax.jit, ...) / @jax.jit on a def whose
+        # params include `cache`
+        for f in self._functions(fn) + [fn]:
+            params = [p for p, _, _ in _param_entries(f)]
+            if "cache" not in params:
+                continue
+            for dec in f.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                name = _dotted(d).rsplit(".", 1)[-1]
+                is_jit = name == "jit" or (
+                    name == "partial" and isinstance(dec, ast.Call)
+                    and dec.args
+                    and _dotted(dec.args[0]).rsplit(".", 1)[-1] == "jit")
+                if not is_jit:
+                    continue
+                kw = ({k.arg for k in dec.keywords}
+                      if isinstance(dec, ast.Call) else set())
+                if "donate_argnums" not in kw and "donate_argnames" not in kw:
+                    self.add("DLG105", "warning", f,
+                             f"jitted `{f.name}` takes a cache but donates "
+                             "nothing — KV cache copies every step")
+
+    # -- traced-context rules (DLG101/102/103/104) -------------------------
+
+    def _lint_traced_fn(self, fn) -> None:
+        taint = _Taint(_traced_params(fn))
+        nested = {n for f in self._functions(fn) if f is not fn
+                  for n in ast.walk(f)}
+        self._walk_stmts(fn.body, taint, fn, skip=nested)
+
+    def _walk_stmts(self, stmts, taint: _Taint, fn, skip) -> None:
+        for stmt in stmts:
+            self._lint_stmt(stmt, taint, fn, skip)
+
+    def _lint_stmt(self, stmt, taint: _Taint, fn, skip) -> None:
+        if stmt in skip:
+            return
+        # sink checks cover THIS statement's own expressions only; nested
+        # blocks are linted by the recursion below AFTER earlier statements
+        # in them have propagated (a pre-walk of the whole subtree would
+        # judge inner lines with stale pre-branch taint — false positives)
+        for node in _stmt_exprs(stmt):
+            if node in skip:
+                continue
+            if isinstance(node, ast.Call):
+                self._check_sync_call(node, taint, "DLG101",
+                                      "inside a traced context")
+                self._check_numpy_call(node, taint)
+            elif self.in_ops and isinstance(node, ast.BinOp):
+                self._check_literal_dtype(node, taint)
+        # control flow on traced booleans
+        if isinstance(stmt, (ast.If, ast.While)):
+            if self._branch_taint(stmt.test, taint):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.add("DLG103", "error", stmt,
+                         f"Python `{kind}` on a traced value "
+                         f"(`{ast.unparse(stmt.test)}`) — use lax.cond/"
+                         "while_loop or jnp.where; this concretizes under "
+                         "jit")
+            self._walk_stmts(stmt.body, taint, fn, skip)
+            self._walk_stmts(stmt.orelse, taint, fn, skip)
+            return
+        if isinstance(stmt, ast.Assert) and self._branch_taint(stmt.test,
+                                                               taint):
+            self.add("DLG103", "error", stmt,
+                     "assert on a traced value — concretizes under jit; "
+                     "use checkify or move the check to host code")
+        # taint propagation
+        if isinstance(stmt, ast.Assign):
+            t = taint.expr(stmt.value)
+            for tgt in stmt.targets:
+                taint.assign(tgt, t)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            taint.assign(stmt.target, taint.expr(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if taint.expr(stmt.value):
+                taint.assign(stmt.target, True)
+        elif isinstance(stmt, ast.For):
+            taint.assign(stmt.target, taint.expr(stmt.iter))
+            self._walk_stmts(stmt.body, taint, fn, skip)
+            self._walk_stmts(stmt.orelse, taint, fn, skip)
+        elif isinstance(stmt, (ast.With,)):
+            self._walk_stmts(stmt.body, taint, fn, skip)
+        elif isinstance(stmt, ast.Try):
+            for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._walk_stmts(block, taint, fn, skip)
+            for h in stmt.handlers:
+                self._walk_stmts(h.body, taint, fn, skip)
+
+    def _branch_taint(self, test: ast.AST, taint: _Taint) -> bool:
+        """Branch-condition taint: bare-name truthiness is NOT flagged —
+        `if layers:` / `if params and ...:` on pytree containers is
+        len()-style static logic, and the real traced-branch bug is a
+        comparison or computation on a traced value (`if pos > 0:`). A
+        tainted Compare/BinOp/Call/Subscript inside the test still fires.
+        """
+        if isinstance(test, ast.Name):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._branch_taint(v, taint) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_taint(test.operand, taint)
+        return taint.expr(test)
+
+    def _check_sync_call(self, node: ast.Call, taint: _Taint, rule: str,
+                         where: str) -> None:
+        fn = _dotted(node.func)
+        leaf = fn.rsplit(".", 1)[-1]
+        # x.item() / x.tolist() / x.block_until_ready()
+        if (isinstance(node.func, ast.Attribute) and leaf in SYNC_METHODS
+                and taint.expr(node.func.value)):
+            self.add(rule, "error" if rule == "DLG101" else "info", node,
+                     f"`.{leaf}()` on `{ast.unparse(node.func.value)}` "
+                     f"{where} — device-to-host sync")
+            return
+        if fn in ("jax.device_get", "device_get"):
+            self.add(rule, "error" if rule == "DLG101" else "info", node,
+                     f"`jax.device_get` {where} — device-to-host sync")
+            return
+        args_tainted = any(taint.expr(a) for a in node.args)
+        if not args_tainted:
+            return
+        if fn in BUILTIN_SYNC_FUNCS:
+            arg = ast.unparse(node.args[0]) if node.args else ""
+            self.add(rule, "error" if rule == "DLG101" else "info", node,
+                     f"`{fn}({arg})` {where} — concretizes/syncs the value")
+        elif fn.startswith(("np.", "numpy.")) and leaf in NUMPY_SYNC_FUNCS:
+            arg = ast.unparse(node.args[0]) if node.args else ""
+            self.add(rule, "error" if rule == "DLG101" else "info", node,
+                     f"`{fn}({arg})` {where} — device-to-host transfer")
+
+    def _check_numpy_call(self, node: ast.Call, taint: _Taint) -> None:
+        fn = _dotted(node.func)
+        if not fn.startswith(("np.", "numpy.")):
+            return
+        leaf = fn.rsplit(".", 1)[-1]
+        if leaf in NUMPY_SYNC_FUNCS:
+            return  # DLG101's finding; don't double-report
+        if any(taint.expr(a) for a in node.args) or any(
+                taint.expr(k.value) for k in node.keywords):
+            self.add("DLG102", "error", node,
+                     f"`{fn}` called on a traced value — numpy cannot "
+                     "trace; this concretizes (host round-trip) or raises "
+                     "TracerError")
+
+    def _check_literal_dtype(self, node: ast.BinOp, taint: _Taint) -> None:
+        for lit, other in ((node.left, node.right), (node.right, node.left)):
+            if isinstance(lit, ast.UnaryOp):
+                lit = lit.operand
+            if (isinstance(lit, ast.Constant) and isinstance(lit.value, float)
+                    and taint.expr(other)):
+                self.add("DLG104", "info", node,
+                         f"bare float literal `{lit.value}` in kernel "
+                         "arithmetic — wrap as jnp.float32(...) so the op "
+                         "dtype is explicit (promotion bait under x64/"
+                         "mixed-precision edits)")
+                return
+
+    # -- DLG107: host-side boundary syncs ----------------------------------
+
+    def _lint_host_fn(self, fn) -> None:
+        """Track device values through HOST code in runtime modules and flag
+        every host-sync conversion. Sources: params annotated jax.Array,
+        results of jnp/compiled-step calls. Deliberate boundaries (sampler
+        input, stats) are baselined or inline-ignored."""
+        taint = _Taint(_device_params(fn))
+        devfns: set[str] = set()  # names holding jitted-step callables
+
+        nested = {n for f in self._functions(fn) if f is not fn
+                  for n in ast.walk(f)}
+
+        class T(_Taint):
+            def expr(self, node):  # calls through jitted handles yield
+                if isinstance(node, ast.Call):  # device values
+                    f = _dotted(node.func)
+                    if f in devfns or f.rsplit(".", 1)[-1] in devfns:
+                        return True
+                    if f.startswith(("jnp.", "jax.numpy.")):
+                        return True
+                    if f in ("self._compiled_step", "jax.device_put"):
+                        return True
+                return _Taint.expr(self, node)
+
+        t = T(taint.names)
+
+        def is_devfn_expr(node) -> bool:
+            if isinstance(node, ast.Subscript):
+                return _dotted(node.value) in ("self._steps",)
+            if isinstance(node, ast.Call):
+                return _dotted(node.func) in ("self._compiled_step",
+                                              "jax.jit", "jit")
+            return False
+
+        # statement order matters: `n = int(n)` must flag the sync AND
+        # un-taint `n` for the lines below — so sinks are checked per
+        # statement BEFORE that statement's assignment propagates
+        def walk_body(stmts):
+            for stmt in stmts:
+                if stmt in nested:
+                    continue
+                for node in _stmt_exprs(stmt):
+                    if node in nested or not isinstance(node, ast.Call):
+                        continue
+                    self._check_sync_call(node, t, "DLG107",
+                                          "at the host-device boundary")
+                if isinstance(stmt, ast.Assign):
+                    if is_devfn_expr(stmt.value):
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                devfns.add(tgt.id)
+                    else:
+                        tv = t.expr(stmt.value)
+                        for tgt in stmt.targets:
+                            t.assign(tgt, tv)
+                elif isinstance(stmt, ast.AugAssign) and t.expr(stmt.value):
+                    t.assign(stmt.target, True)
+                for block in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, block, None)
+                    if isinstance(sub, list):
+                        walk_body(sub)
+                for h in getattr(stmt, "handlers", []):
+                    walk_body(h.body)
+
+        walk_body(fn.body)
+
+
+def lint_source(relpath: str, source: str) -> list[Finding]:
+    return ModuleLinter(relpath, source).run()
+
+
+def lint_file(root: str, relpath: str) -> list[Finding]:
+    # explicit utf-8: the locale default is cp1252 on the Windows CI leg,
+    # which cannot decode this repo's source bytes
+    with open(os.path.join(root, relpath), encoding="utf-8") as f:
+        return lint_source(relpath, f.read())
+
+
+def iter_package_files(pkg_root: str) -> list[str]:
+    """All .py files under the package dir, POSIX-style relative paths,
+    sorted. Posix separators are load-bearing twice over: the scope rules
+    (KERNEL_MODULES etc.) match with '/', and Finding.file is a baseline
+    key that must be identical across platforms."""
+    out = []
+    for dirpath, _, files in os.walk(pkg_root):
+        for name in files:
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, pkg_root).replace(os.sep, "/")
+                out.append(rel)
+    return sorted(out)
+
+
+def lint_package(pkg_root: str, prefix: str = "") -> list[Finding]:
+    """Lint every module under pkg_root; `prefix` is prepended to relative
+    paths in findings (e.g. 'distributed_llama_tpu/')."""
+    findings: list[Finding] = []
+    for rel in iter_package_files(pkg_root):
+        with open(os.path.join(pkg_root, rel), encoding="utf-8") as f:
+            src = f.read()
+        findings.extend(lint_source(prefix + rel, src))
+    return findings

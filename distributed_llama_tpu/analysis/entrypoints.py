@@ -1,0 +1,217 @@
+"""Audited entry points: the public jitted programs, built with tiny
+abstract-friendly inputs.
+
+One registry shared by the jaxpr audit (`python -m
+distributed_llama_tpu.analysis`) and the test suite (tests/conftest.py
+exposes `build_forward_inputs` so tests/test_hlo_wire.py lowers the SAME
+programs the audit walks — the wire model, the HLO counter, and the static
+analyzer all look at one set of entry points).
+
+Inputs are tiny concrete zero-weight models (dim 64, 2 layers): tracing
+never reads values, only shapes/dtypes, and building zeros is cheaper and
+simpler than threading ShapeDtypeStructs through the params pytree. No XLA
+compilation happens here — `jax.make_jaxpr` stops at the jaxpr.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    name: str
+    fn: Callable          # positional-args callable to trace
+    args: tuple           # example inputs (tiny, concrete)
+    meta: dict            # activation_elems: full (B*T*dim) activation size
+    needs_mesh: int = 1   # device count required (skip if unavailable)
+
+
+def _tiny_spec(arch="LLAMA", **overrides):
+    from ..models import ArchType, HiddenAct, ModelSpec
+
+    base = dict(
+        arch=getattr(ArchType, arch), dim=64, hidden_dim=128, n_layers=2,
+        n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=32,
+        hidden_act=HiddenAct.SILU, rope_theta=10000.0,
+    )
+    if arch in ("MIXTRAL", "GROK1"):
+        base.update(n_experts=4, n_active_experts=2)
+    base.update(overrides)
+    return ModelSpec(**base)
+
+
+def _zero_params(spec, dtype=jnp.float32):
+    from ..models.params import load_params, random_tensors
+
+    # random_tensors builds the full HostTensor plan; zeros would do, but
+    # the plan builder is the one source of truth for tensor shapes
+    host = random_tensors(spec, seed=0, scale=0.0)
+    return load_params(spec, host, mode="dense", dtype=dtype)
+
+
+def build_forward_inputs(spec=None, *, batch: int = 1, t: int = 1,
+                         seq_len: int | None = None, dtype=jnp.float32,
+                         arch: str = "LLAMA"):
+    """(spec, params, tokens, pos0, cache) for a forward() call — the shared
+    builder tests/test_hlo_wire.py and the jaxpr audit both trace through.
+    """
+    from ..models.transformer import KVCache
+
+    if spec is None:
+        spec = _tiny_spec(arch)
+    params = _zero_params(spec, dtype)
+    cache = KVCache.create(spec, batch=batch,
+                           seq_len=seq_len or spec.seq_len, dtype=dtype)
+    tokens = jnp.zeros((batch, t), jnp.int32)
+    return spec, params, tokens, jnp.int32(0), cache
+
+
+def entry_points(max_devices: int | None = None,
+                 ) -> tuple[list[EntryPoint], list[tuple[str, int]]]:
+    """The audited programs: (buildable entries, unavailable ones).
+
+    Mesh-dependent entries can only be BUILT when enough devices exist
+    (the CI/lint environment pins 8 virtual CPU devices via XLA_FLAGS,
+    same as tests/conftest.py); the ones that cannot are still DECLARED in
+    the second list as (name, devices_needed) so the audit can fail loudly
+    instead of passing vacuously on a short mesh."""
+    from ..models.transformer import forward
+
+    n_dev = jax.device_count() if max_devices is None else max_devices
+    out: list[EntryPoint] = []
+    unavailable: list[tuple[str, int]] = []
+    if n_dev < 2:
+        unavailable += [("tp_q80_col", 2), ("tp_exact_col", 2),
+                        ("tp_row", 2)]
+    if n_dev < 4:
+        unavailable += [("ep_moe_decode", 4)]
+
+    # -- decode step (single token, donated cache in the engine) ----------
+    spec, params, tok, pos0, cache = build_forward_inputs(t=1)
+
+    def decode_step(params, tok, pos0, cache):
+        return forward(params, spec, tok, pos0, cache,
+                       compute_dtype=jnp.float32)
+
+    out.append(EntryPoint(
+        "decode_step", decode_step, (params, tok, pos0, cache),
+        {"activation_elems": 1 * 1 * spec.dim, "dim": spec.dim}))
+
+    # -- prefill segment (T tokens, logit_index like the engine's bpre) ---
+    spec_p, params_p, tok_p, pos0_p, cache_p = build_forward_inputs(t=8)
+
+    def prefill(params, tok, logit_index, cache):
+        return forward(params, spec_p, tok, jnp.int32(0), cache,
+                       logit_index=logit_index, compute_dtype=jnp.float32)
+
+    out.append(EntryPoint(
+        "prefill", prefill, (params_p, tok_p, jnp.asarray([7]), cache_p),
+        {"activation_elems": 1 * 8 * spec_p.dim, "dim": spec_p.dim}))
+
+    if n_dev >= 2:
+        from ..parallel import make_mesh
+        from ..parallel.tp_q80 import tp_col_matmul, tp_row_matmul
+
+        mesh = make_mesh(tp=2, dp=1)
+        dim, hidden = 64, 128
+        x = jnp.zeros((1, 1, hidden), jnp.float32)
+
+        # -- q80-compressed col-split reduce (the wire-compression path) --
+        from ..parallel.tp_q80 import repack_col_tp
+
+        w_col = repack_col_tp(jnp.zeros((dim, hidden), jnp.float32), 2)
+
+        def tp_q80_col(x, w):
+            return tp_col_matmul(x, w, mesh, reduce="q80",
+                                 compute_dtype=jnp.float32)
+
+        out.append(EntryPoint(
+            "tp_q80_col", tp_q80_col, (x, w_col),
+            {"activation_elems": 1 * 1 * dim, "dim": dim}, needs_mesh=2))
+
+        # -- exact col-split reduce (GSPMD-equivalent shard_map path) -----
+        def tp_exact_col(x, w):
+            return tp_col_matmul(x, w, mesh, reduce="exact",
+                                 compute_dtype=jnp.float32)
+
+        out.append(EntryPoint(
+            "tp_exact_col", tp_exact_col, (x, w_col),
+            {"activation_elems": 1 * 1 * dim, "dim": dim}, needs_mesh=2))
+
+        # -- row-split matmul (communication-free by design) --------------
+        from ..parallel.tp_q80 import TpRowWeight
+
+        xr = jnp.zeros((1, dim), jnp.float32)
+        w_row = TpRowWeight(jnp.zeros((hidden, dim), jnp.float32))
+
+        def tp_row(x, w):
+            return tp_row_matmul(x, w, mesh, compute_dtype=jnp.float32,
+                                 use_pallas=False)
+
+        out.append(EntryPoint(
+            "tp_row", tp_row, (xr, w_row),
+            {"activation_elems": 1 * 1 * dim, "dim": dim}, needs_mesh=2))
+
+    if n_dev >= 4:
+        from ..parallel import make_mesh
+        from ..parallel.ep_moe import repack_moe_ep
+
+        spec_m = _tiny_spec("MIXTRAL")
+        mesh_ep = make_mesh(ep=2, tp=2, dp=1)
+        params_m = _zero_params(spec_m)
+        params_m = dict(params_m)
+        params_m["layers"] = [repack_moe_ep(lw, 2)
+                              for lw in params_m["layers"]]
+        from ..models.transformer import KVCache as _KV
+
+        cache_m = _KV.create(spec_m, batch=1, seq_len=spec_m.seq_len,
+                             dtype=jnp.float32)
+        tok_m = jnp.zeros((1, 1), jnp.int32)
+
+        def ep_moe_decode(params, tok, pos0, cache):
+            return forward(params, spec_m, tok, pos0, cache,
+                           compute_dtype=jnp.float32, tp_mesh=mesh_ep)
+
+        out.append(EntryPoint(
+            "ep_moe_decode", ep_moe_decode,
+            (params_m, tok_m, jnp.int32(0), cache_m),
+            {"activation_elems": 1 * 1 * spec_m.dim, "dim": spec_m.dim},
+            needs_mesh=4))
+
+    return out, unavailable
+
+
+def signature_fingerprint(ep: EntryPoint) -> str:
+    """Hash of the entry point's COMPILATION KEY — the input avals
+    (shape/dtype/weak_type) in pytree order. A drifting fingerprint means
+    the jit cache key changed: a host scalar became a weak-typed Python
+    int (silent retrace per distinct value), an input dtype widened, or an
+    argument was added. DLG204 compares this against the baseline."""
+    import hashlib
+
+    leaves = jax.tree_util.tree_leaves(ep.args)
+    parts = []
+    for leaf in leaves:
+        aval = jax.api_util.shaped_abstractify(leaf)
+        parts.append(f"{aval.shape}:{aval.dtype}:{getattr(aval, 'weak_type', False)}")
+    blob = ep.name + ";" + "|".join(parts)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def make_jaxpr_for(ep: EntryPoint, x64: bool = False):
+    """Trace the entry point to a ClosedJaxpr (no compilation). With
+    x64=True the trace runs under jax.experimental.enable_x64 so an
+    accidental f64 promotion becomes VISIBLE as an f64 aval instead of
+    being silently truncated to f32 by the global x64=off default."""
+    if x64:
+        with jax.experimental.enable_x64():
+            # re-cast inputs under the x64 regime: well-typed code keeps
+            # every explicit dtype; only promotion leaks drift to f64
+            return jax.make_jaxpr(ep.fn)(*ep.args)
+    return jax.make_jaxpr(ep.fn)(*ep.args)

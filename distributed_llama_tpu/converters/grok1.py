@@ -81,7 +81,12 @@ class _ShardWalker:
         if self.current_idx == idx and self.current is not None:
             return
         self.current = None
-        gc.collect()
+        # memory relief between checkpoint shards — but respect a session
+        # that disabled cyclic GC (tests/conftest.py does: collecting jax
+        # objects segfaults on the pinned jaxlib/CPython; refcounting
+        # already frees the dropped shard's tensors)
+        if gc.isenabled():
+            gc.collect()
         path = os.path.join(
             self.folder, f"pytorch_model-{idx:05d}-of-{self.n_files:05d}.bin")
         print(f"💿 loading {os.path.basename(path)}", flush=True)

@@ -185,14 +185,19 @@ def _attention_block(x, lw, spec: ModelSpec, k_cache, v_cache, q_pos, cfg,
         pos0 = q_pos[:, 0]
         k_w = _to_cache_dtype(k.transpose(0, 2, 1, 3), k_cache.dtype)
         v_w = _to_cache_dtype(v.transpose(0, 2, 1, 3), v_cache.dtype)
+        # index literals pinned to the position dtype: bare Python 0s trace
+        # as int64 under x64 and dynamic_(update_)slice rejects mixed index
+        # dtypes — int32 everywhere keeps the program x64-proof (dlgrind
+        # DLG202 traces entry points under enable_x64)
+        zero = jnp.int32(0)
+        start = (zero, zero, pos0[0], zero)
         if write_gate is not None:
-            start = (0, 0, pos0[0], 0)
             k_w = jnp.where(write_gate, k_w,
                             lax.dynamic_slice(k_cache, start, k_w.shape))
             v_w = jnp.where(write_gate, v_w,
                             lax.dynamic_slice(v_cache, start, v_w.shape))
-        k_cache = lax.dynamic_update_slice(k_cache, k_w, (0, 0, pos0[0], 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v_w, (0, 0, pos0[0], 0))
+        k_cache = lax.dynamic_update_slice(k_cache, k_w, start)
+        v_cache = lax.dynamic_update_slice(v_cache, v_w, start)
     if sp_cache_mesh is not None:
         # keep the cache sp-sharded through the functional update: during ring
         # prefill the T-sharded K/V reshards into the S-sharded cache (one

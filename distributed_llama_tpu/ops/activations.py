@@ -12,15 +12,19 @@ _GELU_COEF_A = 0.044715
 
 
 def silu(x: jnp.ndarray) -> jnp.ndarray:
-    # x / (1 + exp(-x)) (ref: src/funcs.cpp:498-506)
+    # x / (1 + exp(-x)) (ref: src/funcs.cpp:498-506); literals pinned to
+    # f32 so the kernel dtype is explicit (dlgrind DLG104)
     xf = x.astype(jnp.float32)
-    return (xf / (1.0 + jnp.exp(-xf))).astype(x.dtype)
+    one = jnp.float32(1.0)
+    return (xf / (one + jnp.exp(-xf))).astype(x.dtype)
 
 
 def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
     # tanh approximation (ref: src/funcs.cpp:487-496)
     xf = x.astype(jnp.float32)
-    out = 0.5 * xf * (1.0 + jnp.tanh(_SQRT_2_OVER_PI * xf * (1.0 + _GELU_COEF_A * xf * xf)))
+    half, one = jnp.float32(0.5), jnp.float32(1.0)
+    out = half * xf * (one + jnp.tanh(
+        _SQRT_2_OVER_PI * xf * (one + _GELU_COEF_A * xf * xf)))
     return out.astype(x.dtype)
 
 

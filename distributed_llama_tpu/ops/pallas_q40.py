@@ -129,7 +129,7 @@ def _dequant_dot(x_lo, x_hi, xsum, pk_u8, s_raw,
         wl, wh = wl.astype(jnp.bfloat16), wh.astype(jnp.bfloat16)
     acc = dot(x_lo, wl)                                  # (T, TD)
     acc += dot(x_hi, wh)
-    acc += dot(xsum, s) * -8.0                           # fold every (nib-8) offset
+    acc += dot(xsum, s) * jnp.float32(-8.0)              # fold every (nib-8) offset
     return acc.astype(out_dtype)
 
 

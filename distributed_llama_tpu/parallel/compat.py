@@ -1,0 +1,39 @@
+"""JAX version compatibility for shard_map.
+
+`shard_map` graduated from `jax.experimental.shard_map` to the `jax`
+top level, and its replication-check kwarg was renamed `check_rep` ->
+`check_vma` in the move. The package targets the new spelling; this shim
+keeps the explicit-sharding layer importable on the older jaxlib the CPU
+CI / test image pins (0.4.x), where the top-level import does not exist.
+
+Usage: `from ..parallel.compat import shard_map` and call with the NEW
+kwarg name (`check_vma=`); the shim translates for old versions.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6
+    _NEEDS_RENAME = False
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NEEDS_RENAME = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None,
+              **kwargs):
+    if check_vma is not None:
+        kwargs["check_rep" if _NEEDS_RENAME else "check_vma"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static mesh-axis size from inside a manual region. `lax.axis_size`
+    arrived after 0.4.x; there, `psum(1, axis)` of the Python literal
+    constant-folds to the same static int."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

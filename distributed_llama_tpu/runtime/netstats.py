@@ -174,7 +174,7 @@ def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
     all-reduce (amortized over iters; sync via device->host transfer, the
     only true sync on tunneled TPU platforms)."""
     import jax
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
@@ -217,7 +217,7 @@ def measure_ppermute_ms(mesh, payload_elems: int, iters: int = 16,
     shift()). Same sync discipline as measure_allreduce_ms. Returns ms per
     hop, 0.0 when the axis is absent/size 1."""
     import jax
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = mesh.shape.get(axis, 1)

@@ -9,9 +9,14 @@ XLA's host-platform device partitioning.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may preset a TPU platform
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# the 8-device convention lives in ONE place, shared with the dlgrind
+# jaxpr audit and the multichip dryrun (utils/virtual_mesh.py is jax-free,
+# so importing it here cannot initialize a backend early)
+from distributed_llama_tpu.utils.virtual_mesh import \
+    ensure_virtual_cpu_devices  # noqa: E402
+
+ensure_virtual_cpu_devices()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
@@ -30,10 +35,62 @@ jax.config.update("jax_compilation_cache_dir",
                                "dllama_tpu_xla"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+import gc  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# the suite segfaults intermittently when Python's cyclic GC traverses
+# jax tracing objects (faulthandler shows "Garbage-collecting" under
+# partial_eval.to_jaxpr frames; an explicit between-test gc.collect()
+# crashed the same way, so it is the traversal itself that is unsafe on
+# this jaxlib/CPython pin, not its timing). Cyclic GC is disabled for the
+# whole run: device buffers and most of the heap are refcount-freed as
+# usual; only cyclic garbage accumulates, which a finite test session
+# tolerates.
+gc.collect()
+gc.freeze()  # startup objects never become garbage — skip scanning them
+gc.disable()
+
+_exit_status: list = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _exit_status[0] = int(exitstatus)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    # interpreter finalization runs a last GC pass over everything the
+    # session accumulated, which crashes the same way (exit code 139 AFTER
+    # the summary printed — the run looked like a segfault to CI). All
+    # reporting is done by the time unconfigure fires: flush and leave
+    # without finalizing, preserving pytest's real exit status.
+    if _exit_status[0] is not None:
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(_exit_status[0])
 
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def forward_entry_inputs(arch: str = "LLAMA", *, batch: int = 1, t: int = 1,
+                         spec=None, dtype=None):
+    """Shared builder for abstract entry-point inputs — (spec, params,
+    tokens, pos0, cache) for a forward() call. The SAME programs the
+    analyzer's jaxpr audit traces (distributed_llama_tpu/analysis/
+    entrypoints.py): test_hlo_wire.py lowers them to count collectives,
+    the audit walks their jaxprs, and both stay in lock-step by
+    construction."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.analysis.entrypoints import \
+        build_forward_inputs
+
+    return build_forward_inputs(spec, batch=batch, t=t,
+                                dtype=dtype or jnp.float32, arch=arch)

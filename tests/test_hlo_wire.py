@@ -17,14 +17,12 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_llama_tpu.models import ArchType
-from distributed_llama_tpu.models.params import load_params
 from distributed_llama_tpu.models.transformer import KVCache, forward
 from distributed_llama_tpu.parallel import make_mesh
 from distributed_llama_tpu.parallel.sharding import cache_pspec, shard_params
 from distributed_llama_tpu.runtime.netstats import estimate_decode_wire
 
-from test_model_forward import make_spec, dense_weights
+from conftest import forward_entry_inputs
 
 
 def _collective_counts(hlo: str) -> dict:
@@ -57,11 +55,9 @@ def test_tp_decode_collectives_match_model():
     """GSPMD tp: the model says 2 partial-sum reduces per layer (wo, w2 —
     the reference's 2 broadcast + 2 gather pairs, SURVEY.md §3.4) plus one
     logits gather for the vocab-sharded wcls."""
-    spec = make_spec(ArchType.LLAMA)
-    host, _ = dense_weights(spec)
+    spec, params, _, _, _ = forward_entry_inputs("LLAMA")
     mesh = make_mesh(tp=2, dp=1)
-    params = shard_params(load_params(spec, host, mode="dense",
-                                      dtype=jnp.float32), mesh)
+    params = shard_params(params, mesh)
     hlo = _lowered_decode_hlo(spec, params, mesh)
     c = _collective_counts(hlo)
 
@@ -81,11 +77,9 @@ def test_sp_decode_collectives_match_model():
     """sp-sharded cache decode: one attention stat merge (psum) per layer
     (parallel/ring_attention.sp_cache_attention), plus the tp reduces when
     tp > 1 and the final logits gather."""
-    spec = make_spec(ArchType.LLAMA)
-    host, _ = dense_weights(spec)
+    spec, params, _, _, _ = forward_entry_inputs("LLAMA")
     mesh = make_mesh(tp=2, sp=2, dp=1)
-    params = shard_params(load_params(spec, host, mode="dense",
-                                      dtype=jnp.float32), mesh)
+    params = shard_params(params, mesh)
     hlo = _lowered_decode_hlo(spec, params, mesh, sp_cache_mesh=mesh)
     c = _collective_counts(hlo)
 
@@ -102,12 +96,10 @@ def test_sp_decode_collectives_match_model():
 def test_ep_decode_collectives_match_model():
     """ep x tp MoE decode: one (ep, tp)-group reduce per layer for the
     expert sum + the attention wo reduce per layer (parallel/ep_moe.py)."""
-    spec = make_spec(ArchType.MIXTRAL)
-    host, _ = dense_weights(spec)
+    spec, params, _, _, _ = forward_entry_inputs("MIXTRAL")
     mesh = make_mesh(ep=2, tp=2, dp=1)
     from distributed_llama_tpu.parallel.ep_moe import repack_moe_ep
 
-    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
     params = dict(params)
     params["layers"] = [repack_moe_ep(lw, 2) for lw in params["layers"]]
     params = shard_params(params, mesh)
@@ -129,7 +121,7 @@ def test_collective_counter_sees_known_program():
     extra reduction is NOT a reliable probe — XLA's all-reduce combiner
     merges independent reduces into one variadic op — so probe with known
     standalone programs instead.)"""
-    from jax import shard_map
+    from distributed_llama_tpu.parallel.compat import shard_map
 
     mesh = make_mesh(tp=2, dp=1)
 
