@@ -114,6 +114,39 @@ def entry_points(max_devices: int | None = None,
         "prefill", prefill, (params_p, tok_p, jnp.asarray([7]), cache_p),
         {"activation_elems": 1 * 8 * spec_p.dim, "dim": spec_p.dim}))
 
+    # -- continuous-batching scheduler hot path (runtime/scheduler.py) ----
+    # slot_decode_step: (B, 1) tokens at per-row positions (the scatter
+    # cache-write path); gated rows pass pos == seq_len. Any host callback
+    # or f64 traced into this program stalls EVERY serving step — the
+    # audit is the CI gate the scheduler rides on.
+    spec_s, params_s, tok_s, _, cache_s = build_forward_inputs(batch=4, t=1)
+    pos_s = jnp.zeros((4,), jnp.int32)
+
+    def slot_decode_step(params, tok, pos, cache):
+        return forward(params, spec_s, tok, pos, cache,
+                       compute_dtype=jnp.float32)
+
+    out.append(EntryPoint(
+        "slot_decode_step", slot_decode_step,
+        (params_s, tok_s, pos_s, cache_s),
+        {"activation_elems": 4 * 1 * spec_s.dim, "dim": spec_s.dim}))
+
+    # slot_prefill_chunk: (B, C) chunk at per-row offsets with per-row
+    # logit_index (C is the engine's only prefill compilation key — tail
+    # chunks pad to C, so this ONE signature covers the whole prefill path)
+    spec_c, params_c, tok_c, _, cache_c = build_forward_inputs(batch=4, t=8)
+    pos_c = jnp.zeros((4,), jnp.int32)
+    lidx_c = jnp.full((4,), 7, jnp.int32)
+
+    def slot_prefill_chunk(params, tok, pos, logit_index, cache):
+        return forward(params, spec_c, tok, pos, cache,
+                       logit_index=logit_index, compute_dtype=jnp.float32)
+
+    out.append(EntryPoint(
+        "slot_prefill_chunk", slot_prefill_chunk,
+        (params_c, tok_c, pos_c, lidx_c, cache_c),
+        {"activation_elems": 4 * 8 * spec_c.dim, "dim": spec_c.dim}))
+
     if n_dev >= 2:
         from ..parallel import make_mesh
         from ..parallel.tp_q80 import tp_col_matmul, tp_row_matmul

@@ -18,25 +18,32 @@ TPU-native equivalent of the reference's dllama-api
     Single-process only — multi-host clusters reset per request so a
     worker-side resync can never desync the processes' prefill shapes
 
-Single-threaded accept loop like the reference (ref: dllama-api.cpp:341-352);
-stdlib http.server, no external deps.
+Front-end: a THREADED accept loop (ThreadingHTTPServer — net-new vs the
+reference's single-threaded accept, ref: dllama-api.cpp:341-352; stdlib
+only, no external deps). With --serve-batch B the process runs the
+continuous-batching scheduler (runtime/scheduler.py): /v1/completions and
+/v1/chat/completions enqueue onto the shared slot scheduler and stream
+tokens per-request as their slot produces them, so concurrent clients
+share one batched decode instead of queueing whole requests. Without it,
+requests serialize on the single engine behind state.engine_lock (the
+reference's behavior, minus dropped connections).
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import (BaseHTTPRequestHandler, HTTPServer,
+                         ThreadingHTTPServer)
 
 import jax
 import numpy as np
 
+from ..runtime.scheduler import PromptTooLong
+
 CHAT_EOS_MARKERS = ("<|eot_id|>", "<|end_of_text|>")
-
-
-class PromptTooLong(ValueError):
-    pass
 
 
 def build_chat_prompt(messages: list[dict]) -> str:
@@ -51,7 +58,8 @@ def build_chat_prompt(messages: list[dict]) -> str:
 
 class ApiState:
     def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama",
-                 lookup_decode: int = 0, serve_batch: int = 0):
+                 lookup_decode: int = 0, serve_batch: int = 0,
+                 serve_chunk: int = 0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -62,39 +70,100 @@ class ApiState:
         # greedy requests draft+verify up to this many tokens per forward
         # (prompt-lookup speculation, runtime/speculative.py); 0 = off
         self.lookup_decode = lookup_decode
-        # POST /v1/batch/completions serves up to this many prompts per
-        # request through one batched engine (0 = endpoint off). Decode is
-        # weight-read-bound, so b rows amortize one weight read — the
-        # single-chip serving-throughput lever (bench.py _batch_row).
+        # serve_batch > 0 runs the continuous-batching scheduler with this
+        # many KV slots: /v1/completions and /v1/chat/completions enqueue
+        # onto it, and POST /v1/batch/completions borrows its engine.
+        # Decode is weight-read-bound, so b live slots amortize one weight
+        # read per step (bench.py's continuous-batching row).
         self.serve_batch = serve_batch
-        self._batch_engine = None
+        self.serve_chunk = serve_chunk  # prefill chunk; 0 = engine default
+        # serializes legacy single-engine requests under the threaded
+        # accept loop (the scheduler path needs no lock — it queues)
+        self.engine_lock = threading.RLock()
+        self._scheduler = None
+
+    def scheduler(self):
+        """The shared continuous-batching scheduler (runtime/scheduler.py),
+        built and started on first use. Its batch=serve_batch engine
+        SHARES the single engine's param device buffers (weights are never
+        duplicated) and owns THE ONLY live batched KV cache in the
+        process: the legacy batch endpoint borrows the same engine via
+        Scheduler.exclusive() instead of allocating a second one.
+        Single-device only — serve() refuses --serve-batch on
+        meshes/clusters at startup."""
+        with self.engine_lock:  # two first requests must not double-build
+            if self._scheduler is None:
+                from ..runtime.engine import Engine
+                from ..runtime.scheduler import Scheduler
+
+                e = self.engine
+                batch_engine = Engine(
+                    e.spec, e.params, batch=self.serve_batch,
+                    max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
+                    cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
+                    pallas_interpret=e.pallas_interpret,
+                    activation_q80=e.activation_q80,
+                    prefill_chunk=e.prefill_chunk)
+                self._scheduler = Scheduler(batch_engine,
+                                            chunk=self.serve_chunk or None)
+                self._scheduler.start()
+            return self._scheduler
 
     def batch_engine(self):
-        """The batch=serve_batch engine, built on first use. It SHARES the
-        single engine's param device buffers (weights are never duplicated;
-        only the extra b-row KV cache is new memory) and mirrors its
-        dtypes/seq_len. Single-device only — serve() refuses --serve-batch
-        on meshes/clusters at startup."""
-        if self._batch_engine is None:
-            from ..runtime.engine import Engine
+        """The batched engine — the SCHEDULER's engine (one live batched
+        KV cache per process; callers stepping it directly must hold
+        Scheduler.exclusive())."""
+        return self.scheduler().engine
 
-            e = self.engine
-            self._batch_engine = Engine(
-                e.spec, e.params, batch=self.serve_batch,
-                max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
-                cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
-                pallas_interpret=e.pallas_interpret,
-                activation_q80=e.activation_q80,
-                prefill_chunk=e.prefill_chunk)
-        return self._batch_engine
+
+def _raw_prompt_body(body: dict) -> bool:
+    """A /v1/completions-shaped body (raw `prompt`, no chat template or
+    chat EOS markers). Inferred from the body, not the route, so the
+    multi-host worker replay (apps/dllama.cmd_worker re-runs the raw body
+    through _completion_chunks) handles both endpoints with no protocol
+    change."""
+    return "messages" not in body and "prompt" in body
+
+
+def _piece_scanner(tokenizer, first_prev: int, markers, stops):
+    """Per-token text scan shared by the single-request streams (the
+    legacy and scheduler paths): eos / chat-marker / stop-sequence
+    semantics live exactly once — the batch endpoint's per-row scan_token
+    mirrors the same rules with per-row state. Returns scan(tok) -> the
+    decoded piece to emit, or None when the request just STOPPED (the
+    token is consumed, never emitted)."""
+    scan_state = {"prev": first_prev, "tail": ""}
+    tail_len = max([len(m) for m in markers]
+                   + [len(s) for s in stops] + [1]) + 16
+    eos = tokenizer.eos_id
+
+    def scan(tok: int) -> str | None:
+        if tok == eos:
+            return None
+        piece = tokenizer.decode_piece(scan_state["prev"], tok).decode(
+            "utf-8", errors="replace")
+        scan_state["prev"] = tok
+        # bounded trailing window (ref: dllama-api.cpp:272-286)
+        scan_state["tail"] = (scan_state["tail"] + piece)[-tail_len:]
+        if (any(m in scan_state["tail"] for m in markers)
+                or (stops and any(s in scan_state["tail"] for s in stops))):
+            return None
+        return piece
+
+    return scan
 
 
 def _completion_chunks(state: ApiState, body: dict):
-    """Generator of generated text pieces for one request."""
+    """Generator of generated text pieces for one request (the legacy
+    single-engine path: prefix reuse, lookup decode, shared sampler)."""
     engine, tokenizer, sampler = state.engine, state.tokenizer, state.sampler
 
-    messages = body.get("messages", [])
-    prompt = build_chat_prompt(messages)
+    if _raw_prompt_body(body):
+        prompt = body.get("prompt") or ""
+        markers: tuple = ()
+    else:
+        prompt = build_chat_prompt(body.get("messages", []))
+        markers = CHAT_EOS_MARKERS
     max_tokens = int(body.get("max_tokens", 0) or 0)
     stops = body.get("stop") or []
     if isinstance(stops, str):
@@ -144,11 +213,8 @@ def _completion_chunks(state: ApiState, body: dict):
     limit = engine.seq_len - len(tokens) - 1
     n_gen = min(max_tokens, limit) if max_tokens > 0 else limit
 
-    prev = tokens[-1]
     n_prompt = len(tokens)
-    tail = ""  # bounded scan window for markers/stop sequences
-    tail_len = max([len(m) for m in CHAT_EOS_MARKERS]
-                   + [len(s) for s in stops] + [1]) + 16
+    scan = _piece_scanner(tokenizer, tokens[-1], markers, stops)
     emitted = 0
     finish = "length"
     def plain_tokens():
@@ -205,17 +271,8 @@ def _completion_chunks(state: ApiState, body: dict):
         else:
             token_iter = plain_tokens()
         for tok in token_iter:
-            if tok == tokenizer.eos_id:
-                finish = "stop"
-                break
-            piece = tokenizer.decode_piece(prev, tok).decode("utf-8", errors="replace")
-            prev = tok
-            tail = (tail + piece)[-tail_len:]
-            if any(m in tail for m in CHAT_EOS_MARKERS):
-                finish = "stop"
-                break
-            # stop-sequence scan over the trailing window (ref: dllama-api.cpp:272-286)
-            if stops and any(s in tail for s in stops):
+            piece = scan(tok)
+            if piece is None:  # eos / chat marker / stop sequence
                 finish = "stop"
                 break
             emitted += 1
@@ -232,6 +289,70 @@ def _completion_chunks(state: ApiState, body: dict):
                     "completion_tokens": emitted})
 
 
+def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
+    """Scheduler-path generator for one /v1/completions or
+    /v1/chat/completions request: enqueue onto the shared
+    continuous-batching scheduler (runtime/scheduler.py) and stream pieces
+    as the request's slot produces tokens — concurrent requests decode in
+    ONE batched step loop instead of serializing on the engine.
+
+    Per-request temperature/seed become a PRIVATE Sampler (the slot's RNG
+    state), so concurrent requests never contend for the shared sampler's
+    coin stream; omitted seeds derive from it (Sampler.next_seed) under the
+    engine lock so results stay run-to-run deterministic. No prefix reuse
+    on this path: slots are leased per request (the legacy single-engine
+    path keeps the feature). Text-level stops cancel the request, freeing
+    its slot immediately."""
+    from ..sampler import Sampler
+
+    tokenizer = state.tokenizer
+    sched = state.scheduler()
+    engine = sched.engine
+    if chat and not _raw_prompt_body(body):
+        prompt = build_chat_prompt(body.get("messages", []))
+        markers: tuple = CHAT_EOS_MARKERS
+    else:
+        prompt = body.get("prompt") or ""
+        markers = ()
+    max_tokens = int(body.get("max_tokens", 0) or 0)
+    stops = body.get("stop") or []
+    if isinstance(stops, str):
+        stops = [stops]
+
+    tokens = tokenizer.encode(prompt)
+    temp = (state.sampler.temperature if body.get("temperature") is None
+            else float(body["temperature"]))
+    with state.engine_lock:  # the shared stream is also the legacy path's
+        seed = (int(body["seed"]) if body.get("seed") is not None
+                else state.sampler.next_seed())
+    sampler = Sampler(tokenizer.vocab_size, temperature=temp,
+                      topp=state.sampler.topp, seed=seed)
+    limit = engine.seq_len - len(tokens) - 1
+    n_gen = min(max_tokens, limit) if max_tokens > 0 else limit
+    # PromptTooLong raises HERE (before any event) — the handler still
+    # turns it into a clean 400 through the queued/threaded path
+    req = sched.submit(tokens, n_gen, sampler, eos_id=tokenizer.eos_id)
+
+    scan = _piece_scanner(tokenizer, tokens[-1], markers, stops)
+    emitted = 0
+    finish = "length"
+    try:
+        for tok in req.tokens():
+            piece = scan(tok)
+            if piece is None:  # eos / chat marker / stop sequence
+                finish = "stop"
+                break
+            emitted += 1
+            yield ("piece", piece)
+    finally:
+        # no-op after a natural finish; on text-level stops, client
+        # disconnects and generator teardown it frees the slot NOW
+        req.cancel()
+    yield ("done", {"finish_reason": finish,
+                    "prompt_tokens": len(tokens),
+                    "completion_tokens": emitted})
+
+
 def _batch_completion_chunks(state: ApiState, body: dict):
     """POST /v1/batch/completions generator: up to serve_batch prompts
     decoded in ONE batched engine (net-new vs the reference's batch=1
@@ -243,8 +364,11 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     batch through the shared reference-parity sampler stream (coins drawn
     in row order — Sampler.sample_batch); rows are independent sequences.
     No prefix reuse here: the batch cache is reset per request (the
-    single-request endpoint keeps that feature)."""
-    engine = state.batch_engine()
+    single-request endpoint keeps that feature). The engine is BORROWED
+    from the scheduler (Scheduler.exclusive drains in-flight slot work
+    first) — one process, one live batched KV cache."""
+    sched = state.scheduler()
+    engine = sched.engine
     tokenizer, sampler = state.tokenizer, state.sampler
 
     if "prompts" in body:
@@ -279,14 +403,12 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     n_gen = min(max_tokens, headroom) if max_tokens > 0 else headroom
     n_prompt_toks = sum(len(r) for r in rows)  # before padding rows join
 
-    saved_temp = sampler.temperature
-    saved_rng_state = None
-    if body.get("temperature") is not None:
-        sampler.set_temp(float(body["temperature"]))
-    if body.get("seed") is not None:
-        saved_rng_state = sampler.rng_state
-        sampler.set_seed(int(body["seed"]))
-
+    # parse every request field BEFORE taking the scheduler's engine: a
+    # malformed value (non-numeric temperature/seed, a non-string stop)
+    # must fail THIS request, never leave the exclusive lock held
+    req_temp = (float(body["temperature"])
+                if body.get("temperature") is not None else None)
+    req_seed = int(body["seed"]) if body.get("seed") is not None else None
     markers = () if raw else CHAT_EOS_MARKERS
     tail_len = max([len(m) for m in markers]
                    + [len(s) for s in stops] + [1]) + 16
@@ -301,7 +423,22 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     rows = rows + [[rows[0][0]]] * n_pad
     stop_flags = np.zeros(engine.batch, bool)
     stop_flags[b:] = True
-    engine.reset()
+
+    # borrow the scheduler's engine for the whole-batch run: exclusive()
+    # drains in-flight slot requests, then blocks the step loop until the
+    # finally below releases it (entered/exited manually so the existing
+    # try/finally keeps its shape — a generator teardown mid-stream still
+    # reaches the finally and releases the scheduler). Nothing between
+    # here and the try may raise: everything fallible was parsed above.
+    _excl = sched.exclusive()
+    _excl.__enter__()
+    saved_temp = sampler.temperature
+    saved_rng_state = None
+    if req_temp is not None:
+        sampler.set_temp(req_temp)
+    if req_seed is not None:
+        saved_rng_state = sampler.rng_state
+        sampler.set_seed(req_seed)
 
     def scan_token(i: int, tok: int) -> str | None:
         """Shared per-token body of both batch paths: eos / marker /
@@ -323,6 +460,7 @@ def _batch_completion_chunks(state: ApiState, body: dict):
         return piece
 
     try:
+        engine.reset()  # slots are drained; the borrowed cache starts clean
         if state.lookup_decode > 0 and sampler.temperature == 0.0:
             # greedy batch requests SPECULATE (Engine.generate_batch_lookup
             # — per-row drafts, one verify forward per step, exact per-row
@@ -358,6 +496,7 @@ def _batch_completion_chunks(state: ApiState, body: dict):
         if saved_rng_state is not None:
             sampler.rng_state = saved_rng_state
         engine.reset()  # the batch cache holds nothing reusable
+        _excl.__exit__(None, None, None)  # hand the engine back
     yield ("done", {
         "finish_reasons": finish,
         "prompt_tokens": n_prompt_toks,
@@ -420,6 +559,28 @@ def _completion_env(rid: str, created: int, model: str, choices: list,
                       "total_tokens": prompt_tokens + completion_tokens}}
 
 
+def _text_chunk_env(rid: str, created: int, model: str, text: str,
+                    finish_reason) -> dict:
+    """One SSE text_completion chunk for the raw /v1/completions route."""
+    return {"id": rid, "object": "text_completion", "created": created,
+            "model": model,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": finish_reason}]}
+
+
+def _text_completion_env(rid: str, created: int, model: str, text: str,
+                         finish_reason, prompt_tokens: int,
+                         completion_tokens: int) -> dict:
+    """The non-streamed text_completion envelope (/v1/completions)."""
+    return {"id": rid, "object": "text_completion", "created": created,
+            "model": model,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": finish_reason}],
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": completion_tokens,
+                      "total_tokens": prompt_tokens + completion_tokens}}
+
+
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -459,11 +620,22 @@ def make_handler(state: ApiState):
                      "created": int(time.time()), "owned_by": "user"}]})
             elif self.path in ("/", "/health"):
                 self._json(200, {"status": "ok"})
+            elif self.path == "/stats":
+                # serving observability: TTFT/ITL percentiles, slot
+                # occupancy, queue depth (runtime/stats.ServeStats). A
+                # stats read must never be the thing that allocates the
+                # batched cache — report idle until a request builds it.
+                if state.serve_batch <= 0:
+                    self._json(200, {"scheduler": "off"})
+                elif state._scheduler is None:
+                    self._json(200, {"scheduler": "idle"})
+                else:
+                    self._json(200, state._scheduler.stats.summary())
             else:
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path not in ("/v1/chat/completions",
+            if self.path not in ("/v1/chat/completions", "/v1/completions",
                                  "/v1/batch/completions"):
                 self._json(404, {"error": "not found"})
                 return
@@ -476,7 +648,8 @@ def make_handler(state: ApiState):
             if self.path == "/v1/batch/completions":
                 self._batch_post(body)
             else:
-                self._chat_post(body)
+                self._chat_post(body,
+                                chat=self.path == "/v1/chat/completions")
 
         def _batch_post(self, body: dict) -> None:
             """POST /v1/batch/completions — up to serve_batch prompts in one
@@ -533,74 +706,114 @@ def make_handler(state: ApiState):
                  for i, fr in enumerate(usage["finish_reasons"])],
                 usage["prompt_tokens"], usage["completion_tokens"]))
 
-        def _chat_post(self, body: dict) -> None:
-            rid = f"chatcmpl-{int(time.time()*1000):x}"
+        def _chat_post(self, body: dict, chat: bool = True) -> None:
+            """/v1/chat/completions (chat=True) and /v1/completions. With
+            the scheduler on (--serve-batch), the request enqueues onto the
+            shared slot scheduler and streams as its slot produces tokens —
+            concurrent clients batch-decode together. Otherwise the legacy
+            single-engine path runs, serialized by state.engine_lock under
+            the threaded accept loop."""
+            rid = (f"{'chatcmpl' if chat else 'cmpl'}-"
+                   f"{int(time.time() * 1000):x}")
             created = int(time.time())
             stream = bool(body.get("stream", False))
 
             multihost = jax.process_count() > 1
-            if multihost:
-                # multi-host cluster: workers replay this exact request from
-                # the raw body (apps/dllama.py cmd_worker); broadcast before
-                # any engine work so their collectives line up with ours
-                from ..parallel import multihost as mh
-                mh.send_api(json.dumps(body).encode())
-
-            # pull the first event before committing a 200 so prompt errors
-            # can still return a clean 4xx
-            gen = _completion_chunks(state, body)
+            use_sched = state.serve_batch > 0 and not multihost
+            if not use_sched:
+                state.engine_lock.acquire()
             try:
-                first = next(gen)
-            except PromptTooLong as e:
-                self._json(400, {"error": str(e)})
-                return
-
-            def events():
-                yield first
-                yield from gen
-
-            def drain():
-                # multi-host: workers replay the FULL request; if this
-                # handler aborts mid-stream (client disconnect), finish the
-                # engine steps anyway so cross-host collectives stay aligned
                 if multihost:
-                    for _ in gen:
-                        pass
+                    # multi-host cluster: workers replay this exact request
+                    # from the raw body (apps/dllama.py cmd_worker);
+                    # broadcast before any engine work so their collectives
+                    # line up with ours
+                    from ..parallel import multihost as mh
+                    mh.send_api(json.dumps(body).encode())
 
-            if stream:
-                self._sse_start()
-                usage = None
+                # pull the first event before committing a 200 so prompt
+                # errors can still return a clean 4xx (on the scheduler
+                # path PromptTooLong surfaces from submit() — through the
+                # queue, before any slot work)
+                gen = (_sched_completion_chunks(state, body, chat=chat)
+                       if use_sched else _completion_chunks(state, body))
+                try:
+                    first = next(gen)
+                except PromptTooLong as e:
+                    self._json(400, {"error": str(e)})
+                    return
+
+                def events():
+                    yield first
+                    yield from gen
+
+                def drain():
+                    # multi-host: workers replay the FULL request; if this
+                    # handler aborts mid-stream (client disconnect), finish
+                    # the engine steps anyway so cross-host collectives
+                    # stay aligned
+                    if multihost:
+                        for _ in gen:
+                            pass
+
+                if chat:
+                    def piece_env(p):
+                        return _chunk_env(rid, created, state.model_name, 0,
+                                          {"content": p}, None)
+
+                    def final_env(fr):
+                        return _chunk_env(rid, created, state.model_name, 0,
+                                          {}, fr)
+                else:
+                    def piece_env(p):
+                        return _text_chunk_env(rid, created,
+                                               state.model_name, p, None)
+
+                    def final_env(fr):
+                        return _text_chunk_env(rid, created,
+                                               state.model_name, "", fr)
+
+                if stream:
+                    self._sse_start()
+                    usage = None
+                    try:
+                        for kind, payload in events():
+                            if kind == "piece":
+                                self._sse(piece_env(payload))
+                            else:
+                                usage = payload
+                    finally:
+                        drain()
+                    self._sse(final_env(usage["finish_reason"]))
+                    self._sse_done()
+                    return
+
+                text = ""
+                usage = {"finish_reason": "length", "prompt_tokens": 0,
+                         "completion_tokens": 0}
                 try:
                     for kind, payload in events():
                         if kind == "piece":
-                            self._sse(_chunk_env(
-                                rid, created, state.model_name, 0,
-                                {"content": payload}, None))
+                            text += payload
                         else:
                             usage = payload
                 finally:
                     drain()
-                self._sse(_chunk_env(rid, created, state.model_name, 0, {},
-                                     usage["finish_reason"]))
-                self._sse_done()
-                return
-
-            text = ""
-            usage = {"finish_reason": "length", "prompt_tokens": 0, "completion_tokens": 0}
-            try:
-                for kind, payload in events():
-                    if kind == "piece":
-                        text += payload
-                    else:
-                        usage = payload
+                if chat:
+                    self._json(200, _completion_env(
+                        rid, created, state.model_name,
+                        [{"index": 0,
+                          "message": {"role": "assistant", "content": text},
+                          "finish_reason": usage["finish_reason"]}],
+                        usage["prompt_tokens"], usage["completion_tokens"]))
+                else:
+                    self._json(200, _text_completion_env(
+                        rid, created, state.model_name, text,
+                        usage["finish_reason"], usage["prompt_tokens"],
+                        usage["completion_tokens"]))
             finally:
-                drain()
-            self._json(200, _completion_env(
-                rid, created, state.model_name,
-                [{"index": 0,
-                  "message": {"role": "assistant", "content": text},
-                  "finish_reason": usage["finish_reason"]}],
-                usage["prompt_tokens"], usage["completion_tokens"]))
+                if not use_sched:
+                    state.engine_lock.release()
 
     return Handler
 
@@ -623,24 +836,35 @@ def serve(args) -> None:
 
     serve_batch = getattr(args, "serve_batch", 0)
     if serve_batch:
-        # the batch engine is single-process/single-device by design: a
-        # mesh needs sharded-batch plumbing and a cluster needs request
-        # replay for b-row steps — loud error beats a silently ignored flag
+        # the scheduler's batch engine is single-process/single-device by
+        # design: a mesh needs sharded-batch plumbing and a cluster needs
+        # request replay for b-row steps — loud error beats a silently
+        # ignored flag
         if getattr(args, "nnodes", 1) > 1 or jax.process_count() > 1:
             sys.exit("error: --serve-batch does not compose with --nnodes")
         if max(getattr(args, k, 1) for k in ("tp", "dp", "sp", "ep", "pp")) > 1:
             sys.exit("error: --serve-batch needs a single-device engine "
                      "(no --tp/--dp/--sp/--ep/--pp)")
+        if session:
+            # scheduler slots are leased per request — there is no single
+            # prefix cache a --session file could describe
+            sys.exit("error: --serve-batch (continuous-batching scheduler) "
+                     "does not compose with --session prefix persistence")
 
     engine, tokenizer, sampler = build_engine(args)
     state = ApiState(engine, tokenizer, sampler,
                      lookup_decode=getattr(args, "lookup_decode", 0),
-                     serve_batch=serve_batch)
+                     serve_batch=serve_batch,
+                     serve_chunk=getattr(args, "serve_chunk", 0))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "
               f"({engine.pos} cached positions)")
-    server = HTTPServer((args.host, args.port), make_handler(state))
+    # threaded accept loop (daemon handler threads): the scheduler path
+    # serves concurrent clients from one batched decode; legacy paths
+    # serialize on state.engine_lock / Scheduler.exclusive
+    server = ThreadingHTTPServer((args.host, args.port),
+                                 make_handler(state))
     print(f"🔌 dllama-api listening on {args.host}:{args.port}")
     try:
         server.serve_forever()
@@ -648,6 +872,8 @@ def serve(args) -> None:
         pass
     finally:
         server.server_close()
+        if state._scheduler is not None:
+            state._scheduler.close()
         if session:
             if save_server_session(state, session):
                 print(f"💾 saved session to {session} "

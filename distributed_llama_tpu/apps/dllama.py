@@ -123,13 +123,25 @@ def build_argparser() -> argparse.ArgumentParser:
                         "sampler (different RNG stream). Net-new: the "
                         "reference is strictly 1 token/forward")
     p.add_argument("--serve-batch", type=int, default=0, metavar="B",
-                   help="api mode: enable POST /v1/batch/completions, up "
-                        "to B prompts decoded in one batched engine (decode "
-                        "is weight-read-bound — B rows amortize one weight "
-                        "read per step for near-Bx aggregate tok/s; only "
-                        "the extra B-row KV cache is new memory). Single-"
-                        "process, single-device engines only. Net-new: the "
-                        "reference serves batch=1")
+                   help="api mode: run the continuous-batching scheduler "
+                        "with B KV slots (runtime/scheduler.py, docs/"
+                        "serving.md) — /v1/completions and /v1/chat/"
+                        "completions join and leave the running decode "
+                        "batch per step, and POST /v1/batch/completions "
+                        "borrows the same engine. Decode is weight-read-"
+                        "bound — B live slots amortize one weight read per "
+                        "step for near-Bx aggregate tok/s; only the B-row "
+                        "KV cache is new memory. Single-process, single-"
+                        "device engines only. Net-new: the reference "
+                        "serves batch=1")
+    p.add_argument("--serve-chunk", type=int, default=0, metavar="C",
+                   help="api mode: prefill chunk width for the continuous-"
+                        "batching scheduler (tail chunks pad to C, so C is "
+                        "the ONLY prefill compilation key; 0 = the "
+                        "engine's prefill chunk, capped to the context). "
+                        "Smaller C bounds the inter-token stall admission "
+                        "adds to running requests; larger C prefills new "
+                        "prompts in fewer steps (docs/serving.md)")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,

@@ -1,4 +1,6 @@
 from .engine import Engine, GenerationResult
-from .stats import StepStats
+from .scheduler import PromptTooLong, Scheduler, ServeRequest
+from .stats import ServeStats, StepStats
 
-__all__ = ["Engine", "GenerationResult", "StepStats"]
+__all__ = ["Engine", "GenerationResult", "PromptTooLong", "Scheduler",
+           "ServeRequest", "ServeStats", "StepStats"]

@@ -937,6 +937,79 @@ class Engine:
                                  history=history, stats=stats,
                                  first_fn=first, verify_fn=verify)
 
+    # -- continuous-batching slot steps (runtime/scheduler.py) ------------
+
+    def slot_prefill_chunk(self, tokens: np.ndarray, pos: np.ndarray,
+                           logit_index: np.ndarray) -> jax.Array:
+        """One chunked-prefill forward over the batched cache: row r writes
+        its (B, C) chunk's K/V at absolute offsets pos[r]..pos[r]+C-1 via
+        the per-row scatter path, without disturbing any other row. Rows
+        not prefilling this call are GATED OFF by passing pos[r] ==
+        seq_len: their write indices land out of bounds and the drop-mode
+        scatter discards them (models/transformer._scatter_cache_write),
+        so a gated row's cache — mid-decode or idle — is untouched.
+        Returns (B, vocab) logits read at per-row `logit_index` within the
+        chunk (only rows finishing their prompt this chunk are consumed;
+        the scheduler skips the D2H fetch entirely for mid-prompt chunks).
+
+        The chunk width C is the ONLY compilation key
+        (slot_prefill_chunk_C): the scheduler pads every tail chunk to a
+        fixed C, so admission order/prompt lengths never mint new
+        executables (the fixed-compilation-key discipline dlgrind DLG204
+        pins). Does NOT touch self.pos — per-slot positions are owned by
+        the scheduler."""
+        b, c = tokens.shape
+        assert b == self.batch, (b, self.batch)
+        key = ("slot_prefill", c)
+        if key not in self._steps:
+            common = self._forward_kwargs()
+
+            def run(params, tokens, pos0, logit_index, cache):
+                return forward(params, self.spec, tokens, pos0, cache,
+                               logit_index=logit_index, **common)
+
+            run.__name__ = f"slot_prefill_chunk_{c}"
+            self._steps[key] = jax.jit(run, donate_argnums=(4,))
+        tok = jnp.asarray(tokens, jnp.int32)
+        posv = jnp.asarray(pos, jnp.int32)
+        if self._token_sharding is not None:
+            tok = jax.device_put(tok, self._token_sharding)
+            posv = jax.device_put(posv,
+                                  NamedSharding(self.mesh, P(DP_AXIS)))
+        logits, self.cache = self._steps[key](
+            self.params, tok, posv, jnp.asarray(logit_index, jnp.int32),
+            self.cache)
+        return logits
+
+    def slot_decode_step(self, tokens: np.ndarray, pos: np.ndarray) -> jax.Array:
+        """One decode step for the slot scheduler: row r feeds tokens[r]
+        at its own absolute position pos[r] (per-row scatter write,
+        donated cache). Rows without a decode token this step pass pos[r]
+        == seq_len — their write drops out of bounds and their logits row
+        is ignored. One compilation key total ("slot_decode"); self.pos is
+        untouched (per-slot positions are the scheduler's)."""
+        b, t = tokens.shape
+        assert b == self.batch and t == 1, (tokens.shape, self.batch)
+        key = "slot_decode"
+        if key not in self._steps:
+            common = self._forward_kwargs()
+
+            def run(params, tokens, pos0, cache):
+                return forward(params, self.spec, tokens, pos0, cache,
+                               **common)
+
+            run.__name__ = "slot_decode_step"
+            self._steps[key] = jax.jit(run, donate_argnums=(3,))
+        tok = jnp.asarray(tokens, jnp.int32)
+        posv = jnp.asarray(pos, jnp.int32)
+        if self._token_sharding is not None:
+            tok = jax.device_put(tok, self._token_sharding)
+            posv = jax.device_put(posv,
+                                  NamedSharding(self.mesh, P(DP_AXIS)))
+        logits, self.cache = self._steps[key](self.params, tok, posv,
+                                              self.cache)
+        return logits
+
     # -- batched speculative (prompt-lookup) greedy generation ------------
 
     def generate_batch_lookup(

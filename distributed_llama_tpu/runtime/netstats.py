@@ -109,6 +109,35 @@ def estimate_decode_wire(
                         {k: v / 1024.0 for k, v in bd.items()})
 
 
+def estimate_serve_wire(
+    spec: ModelSpec,
+    mesh,
+    *,
+    batch: int = 1,
+    occupancy: float | None = None,
+    q80: bool = False,
+    act_bytes: int = 4,
+) -> WireEstimate:
+    """Per-EMITTED-token wire under the continuous-batching scheduler
+    (runtime/scheduler.py): a slot-scheduler decode step moves the full
+    batch-B collective payload no matter how many slots are live (gated
+    rows ride through every collective with the rest of the batch), so
+    the per-emitted-token cost is the batch-B step estimate divided by
+    the mean slot occupancy. occupancy == batch reproduces the static
+    batched estimate; occupancy -> 1 degrades to B× the per-token wire —
+    the quantitative reason queue pressure, not slot count, sets serving
+    efficiency."""
+    step = estimate_decode_wire(spec, mesh, q80=q80, act_bytes=act_bytes,
+                                batch=batch)
+    # `is not None`, not truthiness: a measured occupancy of 0.0 (idle
+    # window) must clamp to the degenerate worst case below, not silently
+    # take the full-batch best case
+    occ = float(occupancy) if occupancy is not None else float(batch)
+    occ = max(min(occ, float(batch)), 1e-6)
+    return WireEstimate(step.sent_kb_per_token / occ,
+                        {k: v / occ for k, v in step.breakdown.items()})
+
+
 COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                       "all-to-all", "collective-permute")
 

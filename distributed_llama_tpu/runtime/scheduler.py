@@ -1,0 +1,382 @@
+"""Continuous batching: a slot-based KV scheduler over the batched Engine.
+
+Iteration-level scheduling in the Orca style (Yu et al., OSDI '22) with the
+slot-reuse KV management popularized by vLLM (Kwon et al., SOSP '23),
+adapted to the fixed-shape compilation discipline of this engine: the KV
+cache is ONE batch=B allocation whose rows ("slots") are leased to requests,
+requests join and leave the running decode batch every step, and every
+device program is one of exactly two executables —
+
+  * ``slot_prefill_chunk_C`` — a (B, C) segment forward writing each
+    prefilling row's chunk at its own offset (tail chunks pad to C, so C is
+    the only prefill compilation key),
+  * ``slot_decode_step``     — a (B, 1) decode step at per-row positions.
+
+Rows not participating in a call are gated off by passing position ==
+seq_len: their cache writes drop out of bounds (models/transformer's
+drop-mode scatter) and their logits are never read. This replaces the
+static batch endpoint's regime — all prompts in one request, serial
+prefill, every slot held until the slowest row drains — with
+iteration-level admission: a finished row's slot is handed to the next
+queued request IMMEDIATELY (no cache zeroing or reallocation; the new
+request overwrites each position before any of its queries can attend it,
+the same invariant decode overruns rely on everywhere in the engine).
+
+Chunked-prefill interleave: each scheduler iteration runs at most ONE
+prefill-chunk forward and ONE decode step, so a newly admitted prompt adds
+at most one chunk's latency to in-flight requests' inter-token gap while
+its own time-to-first-token stays bounded by ceil(len/C) iterations.
+
+Per-slot sampling state is the request's own host ``Sampler`` (its
+xorshift stream IS the per-slot RNG state); greedy requests therefore
+yield EXACTLY the tokens of a sequential ``Engine.generate`` run
+(tests/test_scheduler.py pins token-identical parity, including mid-decode
+joins and early-finish slot handoffs).
+
+Thread model: ``submit()`` is thread-safe; the step loop runs either on
+the ``start()`` background thread or synchronously via ``step()`` (tests,
+the bench). ``exclusive()`` drains all in-flight work and lends the
+batched engine to a legacy whole-batch caller (apps/api_server's
+/v1/batch/completions), so one process never holds two live batched
+caches.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Iterator
+
+import numpy as np
+
+from .stats import RequestStats, ServeStats
+
+
+class PromptTooLong(ValueError):
+    """Prompt does not fit the engine's context window."""
+
+
+class ServeRequest:
+    """One submitted generation request and its event stream.
+
+    The scheduler pushes ``("token", id)`` events as the request's slot
+    produces them, then exactly one terminal event: ``("done", reason)``
+    with reason in {"stop", "length", "cancelled"} or ``("error", msg)``.
+    ``tokens()`` iterates the stream; ``cancel()`` asks the scheduler to
+    retire the request at its next iteration (the consumer-side stop for
+    text-level stop sequences and client disconnects)."""
+
+    def __init__(self, rid: int, prompt: list[int], max_tokens: int,
+                 sampler, stop_ids: set[int]):
+        self.id = rid
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.sampler = sampler
+        self.stop_ids = stop_ids
+        self.events: _queue.Queue = _queue.Queue()
+        self.finished = threading.Event()
+        self.finish_reason: str | None = None
+        self.stats = RequestStats(n_prompt=len(prompt))
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def tokens(self, timeout: float = 600.0) -> Iterator[int]:
+        """Yield generated token ids until the terminal event. `timeout`
+        bounds the wait per event so a dead scheduler thread surfaces as
+        an error instead of a hung consumer."""
+        while True:
+            kind, val = self.events.get(timeout=timeout)
+            if kind == "token":
+                yield val
+            elif kind == "done":
+                return
+            else:
+                raise RuntimeError(f"scheduler error: {val}")
+
+
+class _Slot:
+    """One row of the batched KV cache. state is derived: FREE when req is
+    None, PREFILL while off < len(prompt), DECODE after. `pos` is the next
+    cache write position, `last` the token to feed next step."""
+
+    __slots__ = ("idx", "req", "pos", "off", "n_out", "last")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.req: ServeRequest | None = None
+        self.pos = 0
+        self.off = 0
+        self.n_out = 0
+        self.last = 0
+
+
+class Scheduler:
+    def __init__(self, engine, *, chunk: int | None = None):
+        self.engine = engine
+        self.chunk = int(chunk or min(engine.prefill_chunk, engine.seq_len))
+        assert 1 <= self.chunk <= engine.seq_len, self.chunk
+        self.slots = [_Slot(i) for i in range(engine.batch)]
+        # deque.append/popleft are atomic under the GIL, so submit() never
+        # touches the step mutex: a submitter must not wait out an
+        # in-flight forward (measured: mutex-taking submits stalled a
+        # 2.8 s arrival trace to 8.5 s behind back-to-back steps — lock
+        # handoff is not FIFO)
+        self._queue: deque[ServeRequest] = deque()
+        self._mutex = threading.RLock()  # step()/exclusive() mutual excl.
+        self._wake = threading.Event()
+        self.stats = ServeStats()
+        self._thread: threading.Thread | None = None
+        self._stop = False
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_tokens: int, sampler,
+               eos_id: int | set[int] | None = None) -> ServeRequest:
+        """Enqueue a request; it joins the running batch as soon as a slot
+        frees. `sampler` is PER REQUEST (its RNG stream is the slot's
+        sampling state — concurrent requests never share coins).
+        max_tokens <= 0 prefills and emits nothing (Engine.generate's
+        hard-cap contract). Raises PromptTooLong before queueing when the
+        prompt cannot fit the context."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.engine.seq_len:
+            raise PromptTooLong(
+                f"prompt is {len(prompt)} tokens; context is "
+                f"{self.engine.seq_len}")
+        stop_ids = ({eos_id} if isinstance(eos_id, int)
+                    else set(eos_id or ()))
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        req = ServeRequest(rid, prompt, max_tokens, sampler, stop_ids)
+        req.stats.t_submit = time.perf_counter()
+        with self._rid_lock:
+            self.stats.requests_submitted += 1
+        self.stats.requests.append(req.stats)  # deque.append: atomic
+        self._queue.append(req)
+        self._wake.set()
+        return req
+
+    # -- the scheduling iteration -----------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling iteration: admit queued requests into free slots,
+        run one chunked-prefill forward for prefilling rows, one decode
+        step for decoding rows. Returns False when there was no work.
+        Synchronous entry point (tests/bench drive it directly; the
+        background thread calls the same body)."""
+        with self._mutex:
+            return self._step_locked()
+
+    def has_work(self) -> bool:
+        with self._mutex:
+            return bool(self._queue) or any(s.req is not None
+                                            for s in self.slots)
+
+    def _step_locked(self) -> bool:
+        # reap cancellations FIRST so a disconnected client's request never
+        # burns another forward — in particular a long prompt must not
+        # prefill its remaining chunks into a dead slot
+        for s in self.slots:
+            if s.req is not None and s.req._cancelled:
+                self._finish_slot(s, "cancelled")
+        self._admit()
+        pre = [s for s in self.slots
+               if s.req is not None and s.off < len(s.req.prompt)]
+        dec = [s for s in self.slots
+               if s.req is not None and s.off >= len(s.req.prompt)]
+        if not pre and not dec:
+            return False
+        self.stats.steps += 1
+        self.stats.occupancy.append(len(pre) + len(dec))
+        self.stats.queue_depth.append(len(self._queue))
+        if pre:
+            self._prefill_chunk(pre)
+        if dec:
+            # rows that finished their prompt inside _prefill_chunk above
+            # wait for the NEXT iteration: every live row gets at most one
+            # decode forward per iteration (bounded ITL under admission)
+            self._decode(dec)
+        return True
+
+    def _admit(self) -> None:
+        free = [s for s in self.slots if s.req is None]
+        while free and self._queue:
+            req = self._queue.popleft()
+            if req._cancelled:
+                self._finish_req(req, "cancelled")
+                continue
+            s = free.pop(0)
+            s.req = req
+            s.off = 0
+            s.pos = 0
+            s.n_out = 0
+            s.last = 0
+            # slot "reset" is host-side bookkeeping ONLY — no cache zeroing
+            # or reallocation. The new request's prefill/decode overwrites
+            # every position before any of its queries can attend it, so
+            # the predecessor's stale K/V is unreachable by construction.
+
+    def _prefill_chunk(self, rows: list[_Slot]) -> None:
+        eng = self.engine
+        b, c = eng.batch, self.chunk
+        tok = np.zeros((b, c), np.int32)
+        pos = np.full((b,), eng.seq_len, np.int32)  # gated rows: writes drop
+        lidx = np.zeros((b,), np.int32)
+        finishing = []
+        for s in rows:
+            n = min(c, len(s.req.prompt) - s.off)
+            tok[s.idx, :n] = s.req.prompt[s.off:s.off + n]
+            # tail padding (token 0) writes land beyond the prompt and are
+            # overwritten by decode before any later query attends them
+            pos[s.idx] = s.off
+            lidx[s.idx] = n - 1
+            s.off += n
+            if s.off == len(s.req.prompt):
+                finishing.append(s)
+        logits = eng.slot_prefill_chunk(tok, pos, lidx)
+        if not finishing:
+            return  # mid-prompt chunk: no D2H fetch at all
+        lg = eng.fetch_logits(logits)
+        for s in finishing:
+            s.pos = len(s.req.prompt)
+            if s.req.max_tokens <= 0:
+                # hard-cap contract, same as Engine.generate: the prefill
+                # ran, nothing is emitted
+                self._finish_slot(s, "length")
+                continue
+            self._emit(s, s.req.sampler.sample(lg[s.idx]))
+
+    def _decode(self, rows: list[_Slot]) -> None:
+        # cancellations were reaped at the top of the iteration; a cancel
+        # landing mid-step costs at most this one extra forward
+        live = rows
+        eng = self.engine
+        tok = np.zeros((eng.batch, 1), np.int32)
+        pos = np.full((eng.batch,), eng.seq_len, np.int32)
+        for s in live:
+            tok[s.idx, 0] = s.last
+            pos[s.idx] = s.pos
+        logits = eng.slot_decode_step(tok, pos)
+        lg = eng.fetch_logits(logits)
+        for s in live:
+            s.pos += 1
+            self._emit(s, s.req.sampler.sample(lg[s.idx]))
+
+    def _emit(self, s: _Slot, token: int) -> None:
+        """Record one sampled token and retire the slot the moment the
+        request is done — the freed slot is admissible next iteration.
+        Exactly Engine.generate's continue condition, negated: a stop
+        token is emitted then stops the row; budget and context-edge rows
+        finish as "length". The final emitted token is never fed back
+        (generate() parity — no overrun forward)."""
+        req = s.req
+        token = int(token)
+        s.n_out += 1
+        s.last = token
+        now = time.perf_counter()
+        if req.stats.t_first is None:
+            req.stats.t_first = now
+        req.stats.n_out = s.n_out
+        self.stats.tokens_out += 1
+        req.events.put(("token", token))
+        if token in req.stop_ids:
+            self._finish_slot(s, "stop")
+        elif s.n_out >= req.max_tokens or s.pos >= self.engine.seq_len:
+            self._finish_slot(s, "length")
+
+    def _finish_slot(self, s: _Slot, reason: str) -> None:
+        req, s.req = s.req, None  # slot is FREE from here on
+        self._finish_req(req, reason)
+
+    def _finish_req(self, req: ServeRequest, reason: str) -> None:
+        req.finish_reason = reason
+        req.stats.t_done = time.perf_counter()
+        self.stats.requests_finished += 1
+        req.events.put(("done", reason))
+        req.finished.set()
+
+    # -- background thread -------------------------------------------------
+
+    def start(self) -> None:
+        with self._mutex:
+            if self._thread is not None:
+                return
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name="dllama-scheduler", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop:
+            # clear-before-step ordering: a submit landing after the clear
+            # is either seen by this step (queue appended before set) or
+            # re-arms the event so the wait below returns immediately
+            self._wake.clear()
+            with self._mutex:
+                try:
+                    did = self._step_locked()
+                except Exception as e:  # fail every request, keep serving
+                    self._abort_all(f"{type(e).__name__}: {e}")
+                    did = False
+            if not did and not self._stop:
+                self._wake.wait(timeout=0.05)
+
+    def _abort_all(self, msg: str) -> None:
+        def fail(req: ServeRequest) -> None:
+            req.finish_reason = "error"
+            req.stats.t_done = time.perf_counter()
+            self.stats.requests_finished += 1
+            req.events.put(("error", msg))
+            req.finished.set()
+
+        for s in self.slots:
+            if s.req is not None:
+                req, s.req = s.req, None
+                fail(req)
+        while self._queue:
+            fail(self._queue.popleft())
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        """Lend the batched engine to a legacy whole-batch caller: blocks
+        the step loop, drives every queued/in-flight request to completion
+        on the caller's thread, then yields the engine. The borrower may
+        reset()/step the engine freely — all slots are free while held.
+        This is how the process keeps exactly ONE live batched KV cache
+        (apps/api_server routes /v1/batch/completions through here)."""
+        with self._mutex:
+            while self._step_locked():
+                pass
+            yield self.engine
+
+    # -- observability -----------------------------------------------------
+
+    def wire_estimate(self):
+        """Per-emitted-token collective bytes under the measured mean
+        occupancy (runtime/netstats.estimate_serve_wire): a gated slot
+        still rides through every collective, so low occupancy inflates
+        the per-token wire cost proportionally."""
+        from .netstats import estimate_serve_wire
+
+        occ = (sum(self.stats.occupancy) / len(self.stats.occupancy)
+               if self.stats.occupancy else self.engine.batch)
+        return estimate_serve_wire(
+            self.engine.spec, self.engine.mesh, batch=self.engine.batch,
+            occupancy=occ, q80=self.engine.q80_collectives)

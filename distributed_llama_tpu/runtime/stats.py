@@ -38,3 +38,89 @@ class RunStats:
             device_ms=sum(s.device_ms for s in body) / n,
             host_ms=sum(s.host_ms for s in body) / n,
         )
+
+
+# -- serving (continuous-batching scheduler) counters ----------------------
+
+
+def percentile(xs: list, p: float):
+    """Nearest-rank percentile over a small sample (None when empty) —
+    TTFT/ITL distributions are tens of requests, not enough to justify
+    interpolation."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, round(p / 100.0 * (len(xs) - 1))))
+    return xs[k]
+
+
+@dataclasses.dataclass
+class RequestStats:
+    """Per-request serving latency record (runtime/scheduler.py): TTFT is
+    submit -> first emitted token (queue wait + prefill included — the
+    number a client actually experiences), ITL the mean gap between
+    subsequent tokens of the request."""
+
+    n_prompt: int = 0
+    n_out: int = 0
+    t_submit: float = 0.0
+    t_first: float | None = None
+    t_done: float | None = None
+
+    @property
+    def ttft_ms(self) -> float | None:
+        if self.t_first is None:
+            return None
+        return (self.t_first - self.t_submit) * 1e3
+
+    @property
+    def itl_ms(self) -> float | None:
+        if self.t_first is None or self.t_done is None or self.n_out < 2:
+            return None
+        return (self.t_done - self.t_first) / (self.n_out - 1) * 1e3
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Scheduler-level serving counters: running totals plus BOUNDED
+    sliding windows (`window` most-recent entries) of per-iteration
+    occupancy/queue-depth samples and per-request latency records — a
+    long-running server must not grow a list per step forever, and the
+    percentile sort on GET /stats must stay O(window). The
+    aggregate-throughput denominators (wall clock) belong to the caller —
+    this object only owns what the scheduler alone can observe."""
+
+    window: int = 10_000
+    requests_submitted: int = 0
+    requests_finished: int = 0
+    tokens_out: int = 0
+    steps: int = 0
+
+    def __post_init__(self):
+        from collections import deque
+
+        self.requests = deque(maxlen=self.window)   # RequestStats records
+        self.occupancy = deque(maxlen=self.window)  # live slots, per step
+        self.queue_depth = deque(maxlen=self.window)
+
+    def summary(self) -> dict:
+        """JSON-ready snapshot (the API server's GET /stats and the bench's
+        Poisson-arrival row both emit this). Percentiles and occupancy
+        cover the sliding window; the totals are lifetime counters."""
+        ttfts = [r.ttft_ms for r in self.requests if r.ttft_ms is not None]
+        itls = [r.itl_ms for r in self.requests if r.itl_ms is not None]
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        return {
+            "requests_submitted": self.requests_submitted,
+            "requests_finished": self.requests_finished,
+            "tokens_out": self.tokens_out,
+            "ttft_p50_ms": rnd(percentile(ttfts, 50)),
+            "ttft_p99_ms": rnd(percentile(ttfts, 99)),
+            "itl_p50_ms": rnd(percentile(itls, 50)),
+            "itl_p99_ms": rnd(percentile(itls, 99)),
+            "mean_slot_occupancy": rnd(sum(self.occupancy)
+                                       / len(self.occupancy))
+            if self.occupancy else 0.0,
+            "max_queue_depth": max(self.queue_depth, default=0),
+            "steps": self.steps,
+        }

@@ -494,6 +494,179 @@ def test_cli_dp_lookup_matches_plain(tmp_path, rng, capsys):
     assert plain == spec == single
 
 
+@pytest.fixture
+def sched_api_server(tmp_path, rng):
+    """Threaded server with the continuous-batching scheduler on:
+    /v1/completions and /v1/chat/completions enqueue onto the shared slot
+    scheduler (f32 — the batched step paths contain bf16 dots XLA's CPU
+    thunks cannot execute, same as the batch fixture)."""
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=2, serve_chunk=16)
+    from http.server import ThreadingHTTPServer
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address, state
+    server.shutdown()
+    if state._scheduler is not None:
+        state._scheduler.close()
+
+
+def _sse_events(raw: str) -> list:
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events and events[-1] == "[DONE]"
+    return [json.loads(e) for e in events[:-1]]
+
+
+def test_api_threaded_concurrent_streaming_clients(sched_api_server):
+    """Two concurrent streaming clients with different prompt lengths both
+    complete through the shared scheduler, each with well-formed SSE."""
+    (host, port), state = sched_api_server
+    results = {}
+
+    def client(key, content, n):
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        req = {"messages": [{"role": "user", "content": content}],
+               "max_tokens": n, "temperature": 0, "stream": True}
+        conn.request("POST", "/v1/chat/completions", json.dumps(req),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        results[key] = (resp.status, resp.getheader("Content-Type"),
+                        resp.read().decode())
+
+    threads = [threading.Thread(target=client, args=("a", "ab", 6)),
+               threading.Thread(target=client,
+                                args=("b", "abab baba abba x", 9))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+        assert not t.is_alive()
+
+    for key in ("a", "b"):
+        status, ctype, raw = results[key]
+        assert status == 200
+        assert ctype.startswith("text/event-stream")
+        parsed = _sse_events(raw)
+        # every chunk is a well-formed per-request envelope; exactly one
+        # terminal chunk carries the finish_reason
+        assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+        assert all(p["choices"][0]["index"] == 0 for p in parsed)
+        finals = [p for p in parsed if p["choices"][0]["finish_reason"]]
+        assert len(finals) == 1
+        assert finals[0]["choices"][0]["finish_reason"] in ("stop", "length")
+    assert len(state.scheduler().stats.requests) == 2
+
+
+def test_api_sched_greedy_matches_legacy_single(sched_api_server, tmp_path,
+                                                rng):
+    """A greedy chat request served through the scheduler must be
+    byte-identical to the legacy single-engine path answering it alone
+    (continuous batching is a scheduling change, not a sampling one)."""
+    from distributed_llama_tpu.apps.api_server import _completion_chunks
+
+    (host, port), state = sched_api_server
+    body = {"messages": [{"role": "user", "content": "abba"}],
+            "max_tokens": 6, "temperature": 0}
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    got = json.loads(resp.read())["choices"][0]["message"]["content"]
+
+    legacy = ApiState(state.engine, state.tokenizer, state.sampler)
+    legacy.engine.reset()
+    want = "".join(p for kind, p in _completion_chunks(legacy, dict(body))
+                   if kind == "piece")
+    assert got == want
+
+
+def test_api_completions_route_scheduler(sched_api_server):
+    """POST /v1/completions (raw prompt, no chat template) through the
+    scheduler: valid text_completion envelope, consistent usage."""
+    (host, port), state = sched_api_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"prompt": "ab", "max_tokens": 5, "temperature": 0}
+    conn.request("POST", "/v1/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["object"] == "text_completion"
+    choice = body["choices"][0]
+    assert isinstance(choice["text"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    assert body["usage"]["completion_tokens"] <= 5
+    assert body["usage"]["total_tokens"] == (
+        body["usage"]["prompt_tokens"] + body["usage"]["completion_tokens"])
+
+
+def test_api_completions_route_legacy(api_server):
+    """The raw /v1/completions route also works without the scheduler
+    (single engine behind the lock) — including SSE streaming."""
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"prompt": "ab", "max_tokens": 3, "temperature": 0,
+           "stream": True}
+    conn.request("POST", "/v1/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    parsed = _sse_events(resp.read().decode())
+    assert all(p["object"] == "text_completion" for p in parsed)
+    assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_api_sched_prompt_too_long_clean_400(sched_api_server):
+    """A prompt larger than seq_len must return a clean 400 through the
+    queued/threaded scheduler path (PromptTooLong from submit), and the
+    server must keep serving afterwards."""
+    (host, port), state = sched_api_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"messages": [{"role": "user", "content": "x" * 400}],
+           "max_tokens": 2, "temperature": 0}
+    conn.request("POST", "/v1/chat/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    assert "tokens" in json.loads(resp.read())["error"]
+
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": "ab", "max_tokens": 2,
+                             "temperature": 0}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 200
+
+
+def test_api_stats_route(sched_api_server):
+    """GET /stats exposes the scheduler's serving counters after a
+    request has been served."""
+    (host, port), state = sched_api_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": "ab", "max_tokens": 3,
+                             "temperature": 0}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 200
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/stats")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    s = json.loads(resp.read())
+    assert s["requests_finished"] >= 1
+    assert s["tokens_out"] >= 1
+    assert s["ttft_p50_ms"] is not None and s["ttft_p50_ms"] >= 0
+
+
 @pytest.mark.parametrize("wt", [FloatType.F32, FloatType.Q80])
 def test_cli_runs_f32_and_q80_weight_files(tmp_path, rng, capsys, wt):
     """The reference converts/serves q40, q80 AND f32 weight files

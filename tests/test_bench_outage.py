@@ -87,6 +87,35 @@ def test_healthy_run_emits_one_parseable_line():
     assert row["unit"] == "ms/token"
 
 
+def test_serve_row_emits_valid_json():
+    """BENCH_SERVE=1 adds the continuous-batching Poisson-arrival row
+    (bench._serve_row) with the serving metrics the scheduler promises —
+    aggregate tok/s, the static-batch ratio, TTFT/ITL percentiles — all
+    as one valid JSON variant (a tiny trace keeps this smoke-fast; the
+    default bench stays serve-free)."""
+    r = _run_bench({
+        "BENCH_SERVE": "1",
+        "BENCH_SERVE_REQUESTS": "4",
+        "BENCH_SERVE_BATCH": "2",
+        "BENCH_SERVE_BUDGETS": "4,8",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    serve = [v for v in row.get("variants", [])
+             if "continuous" in v["metric"]]
+    assert len(serve) == 1, row
+    s = serve[0]
+    assert s["unit"] == "tok/s" and s["value"] > 0
+    assert s["static_agg_tok_per_s"] > 0 and s["vs_static_batch"] > 0
+    assert s["batch"] == 2 and s["requests"] >= 2
+    assert s["ttft_p50_ms"] >= 0 and s["ttft_p99_ms"] >= s["ttft_p50_ms"]
+    assert 0 < s["mean_slot_occupancy"] <= 2
+    json.dumps(s)  # the row round-trips as machine-readable JSON
+
+
 @pytest.mark.slow  # full dryrun compile in a subprocess (~100 s)
 def test_dryrun_pins_cpu_before_any_jax_call():
     # dryrun_multichip must succeed with NO ambient cpu pin — the driver's
