@@ -605,6 +605,153 @@ def _serve_row(params, spec: ModelSpec, prefix: str, b: int = 8) -> dict:
     }
 
 
+def _chaos_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
+    """Serving resilience under injected faults (the ISSUE-3 metric):
+    replay a fixed-seed Poisson arrival trace through the SUPERVISED
+    scheduler (runtime/resilience.EngineSupervisor) with deterministic
+    step crashes injected mid-trace (runtime/faults.py), and report what a
+    client fleet actually experiences:
+
+      * availability %      — fraction of wall time /readyz would be 200
+                              (polled at 5 ms)
+      * recovered vs failed — requests that got a structured error frame
+                              and succeeded on ONE client retry, vs ones
+                              that did not
+      * recovery p50 ms     — failure detected -> ready again
+                              (SupervisorStats.recovery_ms)
+
+    Env knobs: BENCH_CHAOS_REQUESTS (default 24), BENCH_CHAOS_BATCH
+    (default 4), BENCH_CHAOS_CRASHES (default 2 — spaced across the
+    trace: each next crash arms only after the previous recovery)."""
+    import gc
+    import threading
+    import time
+
+    from distributed_llama_tpu.runtime.faults import FAULTS
+    from distributed_llama_tpu.runtime.resilience import EngineSupervisor
+    from distributed_llama_tpu.runtime.scheduler import RequestError
+    from distributed_llama_tpu.sampler import Sampler
+
+    b = int(os.environ.get("BENCH_CHAOS_BATCH", str(b)))
+    n_req = max(int(os.environ.get("BENCH_CHAOS_REQUESTS", "24")), 2)
+    n_crashes = int(os.environ.get("BENCH_CHAOS_CRASHES", "2"))
+    seq = min(512, spec.seq_len)
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+    rng = np.random.default_rng(0)
+    lens = [(8, 16, 32)[i % 3] for i in range(n_req)]
+    prompts = [rng.integers(1, spec.vocab_size, n).astype(np.int64).tolist()
+               for n in lens]
+    budgets = [int(x) for x in rng.integers(8, 33, n_req)]
+    arrivals = np.cumsum(rng.exponential(0.05, n_req))
+
+    def factory():
+        return Engine(spec, params, compute_dtype=cdt, cache_dtype=cdt,
+                      max_seq_len=seq, batch=b)
+
+    sup = EngineSupervisor(factory, chunk=32, stall_timeout=60.0,
+                           backoff_base=0.05, breaker_threshold=10_000)
+
+    def greedy():
+        return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=7)
+
+    # availability sampler: what /readyz would answer, at 5 ms resolution
+    ready_samples: list[bool] = []
+    sampling = threading.Event()
+    sampling.set()
+
+    def sample_ready():
+        while sampling.is_set():
+            ready_samples.append(sup.ready)
+            time.sleep(0.005)
+
+    # crash scheduler: arm the next step crash only after the previous
+    # recovery completed, so crashes SPACE OUT across the trace instead of
+    # burning the breaker on back-to-back failures
+    def inject_crashes():
+        for k in range(n_crashes):
+            while sup.sup_stats.recoveries < k and sampling.is_set():
+                time.sleep(0.01)
+            if not sampling.is_set():
+                return
+            FAULTS.arm("step_raise", after=5)  # a few steps of grace
+
+    results = {"ok_first": 0, "recovered": 0, "unrecovered": 0}
+    res_lock = threading.Lock()
+
+    def run_request(prompt, budget):
+        # one client-side retry: a structured error frame (RequestError)
+        # or an unready rejection waits for /readyz then resubmits once
+        for attempt in range(2):
+            try:
+                while not sup.ready:
+                    time.sleep(0.02)
+                req = sup.submit(prompt, budget, greedy())
+                n = sum(1 for _ in req.tokens(timeout=120.0))
+                with res_lock:
+                    results["ok_first" if attempt == 0
+                            else "recovered"] += 1
+                return n
+            except RequestError:
+                if attempt == 1:
+                    with res_lock:
+                        results["unrecovered"] += 1
+            except Exception:  # noqa: BLE001 — unready race on submit
+                if attempt == 1:
+                    with res_lock:
+                        results["unrecovered"] += 1
+        return 0
+
+    threads: list[threading.Thread] = []
+    tokens_out = [0] * n_req
+
+    def client(i):
+        tokens_out[i] = run_request(prompts[i], budgets[i])
+
+    t0 = time.perf_counter()
+    samp = threading.Thread(target=sample_ready, daemon=True)
+    samp.start()
+    inj = threading.Thread(target=inject_crashes, daemon=True)
+    inj.start()
+    try:
+        for i in range(n_req):
+            dt = t0 + arrivals[i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            t = threading.Thread(target=client, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=240.0)
+    finally:
+        sampling.clear()
+        FAULTS.clear()
+    wall = time.perf_counter() - t0
+    samp.join(timeout=2.0)
+    availability = (100.0 * sum(ready_samples) / len(ready_samples)
+                    if ready_samples else 0.0)
+    rec = sorted(sup.sup_stats.recovery_ms)
+    rec_p50 = rec[len(rec) // 2] if rec else None
+    summary = sup.summary()
+    sup.close()
+    del sup
+    gc.collect()
+    return {
+        "metric": f"{prefix}_chaos_batch{b}_availability_pct",
+        "value": round(availability, 2), "unit": "%", "vs_baseline": None,
+        "requests": n_req,
+        "crashes_injected": summary["resilience"]["crashes"],
+        "ok_first_attempt": results["ok_first"],
+        "recovered_by_retry": results["recovered"],
+        "unrecovered": results["unrecovered"],
+        "requests_failed_frames": summary["requests_failed"],
+        "recoveries": summary["resilience"]["recoveries"],
+        "recovery_p50_ms": round(rec_p50, 1) if rec_p50 is not None else None,
+        "tokens_out": int(sum(tokens_out)),
+        "wall_s": round(wall, 2),
+    }
+
+
 def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
     """Extra measured rows for the default 7b run: prefill throughput,
     8k-fill long-context decode (bf16 and fp8 caches — the documented fp8
@@ -811,6 +958,13 @@ def main() -> None:
             # behind a flag so the default bench ladder stays fast; the
             # driver opts in with BENCH_SERVE=1 for the serving A/B
             emit(_serve_row(params, spec,
+                            prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_CHAOS", "0") != "0":
+            # resilience row (runtime/resilience.py): the Poisson trace
+            # replayed with injected mid-trace crashes — availability %,
+            # recovered-request counts, recovery p50
+            emit(_chaos_row(params, spec,
                             prefix=metric.split("_decode")[0]))
 
         # extra capability rows, measured in the same run (driver default

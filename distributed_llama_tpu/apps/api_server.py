@@ -41,9 +41,17 @@ from http.server import (BaseHTTPRequestHandler, HTTPServer,
 import jax
 import numpy as np
 
-from ..runtime.scheduler import PromptTooLong
+from ..runtime.resilience import EngineUnready
+from ..runtime.scheduler import PromptTooLong, QueueFull, RequestError
 
 CHAT_EOS_MARKERS = ("<|eot_id|>", "<|end_of_text|>")
+
+
+class BadRequest(ValueError):
+    """Deterministic client-input error (malformed temperature/seed/stop/
+    prompt types): must map to HTTP 400, never to a retryable 503 — a
+    well-behaved client would otherwise retry the permanently-invalid
+    request forever."""
 
 
 def build_chat_prompt(messages: list[dict]) -> str:
@@ -59,11 +67,21 @@ def build_chat_prompt(messages: list[dict]) -> str:
 class ApiState:
     def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama",
                  lookup_decode: int = 0, serve_batch: int = 0,
-                 serve_chunk: int = 0):
+                 serve_chunk: int = 0, queue_depth: int = 0,
+                 request_deadline: float = 0.0, stall_timeout: float = 0.0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
         self.model_name = model_name
+        # resilience config (docs/operations.md): bounded admission queue
+        # (0 = 4x serve_batch), per-request end-to-end deadline seconds
+        # (0 = off), watchdog stall bound seconds (0 = default 10)
+        self.queue_depth = queue_depth
+        self.request_deadline = request_deadline
+        self.stall_timeout = stall_timeout
+        # graceful drain (SIGTERM): admissions stop, /readyz goes 503,
+        # in-flight work finishes up to --drain-timeout
+        self.draining = False
         # token history whose K/V writes are live in the engine cache
         # (prefix/session reuse — see _completion_chunks)
         self.cached_tokens: list[int] = []
@@ -83,30 +101,38 @@ class ApiState:
         self._scheduler = None
 
     def scheduler(self):
-        """The shared continuous-batching scheduler (runtime/scheduler.py),
+        """The SUPERVISED continuous-batching front door
+        (runtime/resilience.EngineSupervisor over runtime/scheduler.py),
         built and started on first use. Its batch=serve_batch engine
         SHARES the single engine's param device buffers (weights are never
         duplicated) and owns THE ONLY live batched KV cache in the
         process: the legacy batch endpoint borrows the same engine via
-        Scheduler.exclusive() instead of allocating a second one.
-        Single-device only — serve() refuses --serve-batch on
-        meshes/clusters at startup."""
+        Scheduler.exclusive() instead of allocating a second one. The
+        supervisor's engine_factory builds the same engine again on crash
+        recovery — weights still shared, only the KV cache and jit
+        wrappers are new. Single-device only — serve() refuses
+        --serve-batch on meshes/clusters at startup."""
         with self.engine_lock:  # two first requests must not double-build
             if self._scheduler is None:
                 from ..runtime.engine import Engine
-                from ..runtime.scheduler import Scheduler
+                from ..runtime.resilience import EngineSupervisor
 
                 e = self.engine
-                batch_engine = Engine(
-                    e.spec, e.params, batch=self.serve_batch,
-                    max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
-                    cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
-                    pallas_interpret=e.pallas_interpret,
-                    activation_q80=e.activation_q80,
-                    prefill_chunk=e.prefill_chunk)
-                self._scheduler = Scheduler(batch_engine,
-                                            chunk=self.serve_chunk or None)
-                self._scheduler.start()
+
+                def engine_factory():
+                    return Engine(
+                        e.spec, e.params, batch=self.serve_batch,
+                        max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
+                        cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
+                        pallas_interpret=e.pallas_interpret,
+                        activation_q80=e.activation_q80,
+                        prefill_chunk=e.prefill_chunk)
+
+                self._scheduler = EngineSupervisor(
+                    engine_factory, chunk=self.serve_chunk or None,
+                    max_queue=self.queue_depth or 4 * self.serve_batch,
+                    request_deadline=self.request_deadline or None,
+                    stall_timeout=self.stall_timeout or 10.0)
             return self._scheduler
 
     def batch_engine(self):
@@ -336,6 +362,7 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
     scan = _piece_scanner(tokenizer, tokens[-1], markers, stops)
     emitted = 0
     finish = "length"
+    err = None
     try:
         for tok in req.tokens():
             piece = scan(tok)
@@ -344,13 +371,23 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
                 break
             emitted += 1
             yield ("piece", piece)
+    except RequestError as e:
+        # structured failure frame (crash/stall recovery, deadline,
+        # shutdown): the stream TERMINATES with finish_reason "error" and
+        # the frame rides the done event — an already-streaming SSE client
+        # receives an explicit error event, never a silent hang
+        finish = "error"
+        err = e.frame()
     finally:
         # no-op after a natural finish; on text-level stops, client
         # disconnects and generator teardown it frees the slot NOW
         req.cancel()
-    yield ("done", {"finish_reason": finish,
-                    "prompt_tokens": len(tokens),
-                    "completion_tokens": emitted})
+    done = {"finish_reason": finish,
+            "prompt_tokens": len(tokens),
+            "completion_tokens": emitted}
+    if err is not None:
+        done["error"] = err
+    yield ("done", done)
 
 
 def _batch_completion_chunks(state: ApiState, body: dict):
@@ -371,48 +408,56 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     engine = sched.engine
     tokenizer, sampler = state.tokenizer, state.sampler
 
-    if "prompts" in body:
-        texts = body["prompts"]
-        raw = True
-    else:
-        texts = [build_chat_prompt(m) for m in body.get("messages_list", [])]
-        raw = False
-    b = len(texts)
-    if not (1 <= b <= state.serve_batch):
-        raise PromptTooLong(
-            f"batch size {b} outside 1..{state.serve_batch} "
-            "(server started with --serve-batch "
-            f"{state.serve_batch})")
-    max_tokens = int(body.get("max_tokens", 64))
-    stops = body.get("stop") or []
-    if isinstance(stops, str):
-        stops = [stops]
-
-    rows = [tokenizer.encode(t) for t in texts]  # add_bos default, like the single path
-    limit = engine.seq_len - 1
-    for i, r in enumerate(rows):
-        if len(r) >= limit:
+    # parse EVERY request field BEFORE taking the scheduler's engine: a
+    # malformed value (non-numeric temperature/seed, a non-string stop or
+    # prompt) must fail THIS request as a 400, never leave the exclusive
+    # lock held or read as a retryable engine failure
+    try:
+        if "prompts" in body:
+            texts = body["prompts"]
+            raw = True
+        else:
+            texts = [build_chat_prompt(m)
+                     for m in body.get("messages_list", [])]
+            raw = False
+        b = len(texts)
+        if not (1 <= b <= state.serve_batch):
             raise PromptTooLong(
-                f"prompt {i}: {len(r)} tokens >= context {limit}")
-    # budget: MAX over rows of the per-row cache headroom (rows share the
-    # step loop; a longer-prompt row hitting seq_len retires only itself —
-    # the engine's per-row pos guard — so one long prompt must not cap the
-    # shorter rows' output). max_tokens <= 0 means "generate to the context
-    # limit", mirroring the single-request endpoint's semantics.
-    headroom = max(limit - len(r) for r in rows)
-    n_gen = min(max_tokens, headroom) if max_tokens > 0 else headroom
-    n_prompt_toks = sum(len(r) for r in rows)  # before padding rows join
+                f"batch size {b} outside 1..{state.serve_batch} "
+                "(server started with --serve-batch "
+                f"{state.serve_batch})")
+        max_tokens = int(body.get("max_tokens", 64))
+        stops = body.get("stop") or []
+        if isinstance(stops, str):
+            stops = [stops]
 
-    # parse every request field BEFORE taking the scheduler's engine: a
-    # malformed value (non-numeric temperature/seed, a non-string stop)
-    # must fail THIS request, never leave the exclusive lock held
-    req_temp = (float(body["temperature"])
-                if body.get("temperature") is not None else None)
-    req_seed = int(body["seed"]) if body.get("seed") is not None else None
-    markers = () if raw else CHAT_EOS_MARKERS
-    tail_len = max([len(m) for m in markers]
-                   + [len(s) for s in stops] + [1]) + 16
-    prev = [r[-1] for r in rows]
+        rows = [tokenizer.encode(t) for t in texts]  # add_bos default,
+        limit = engine.seq_len - 1                   # like the single path
+        for i, r in enumerate(rows):
+            if len(r) >= limit:
+                raise PromptTooLong(
+                    f"prompt {i}: {len(r)} tokens >= context {limit}")
+        # budget: MAX over rows of the per-row cache headroom (rows share
+        # the step loop; a longer-prompt row hitting seq_len retires only
+        # itself — the engine's per-row pos guard — so one long prompt
+        # must not cap the shorter rows' output). max_tokens <= 0 means
+        # "generate to the context limit", like the single endpoint.
+        headroom = max(limit - len(r) for r in rows)
+        n_gen = min(max_tokens, headroom) if max_tokens > 0 else headroom
+        n_prompt_toks = sum(len(r) for r in rows)  # before padding joins
+
+        req_temp = (float(body["temperature"])
+                    if body.get("temperature") is not None else None)
+        req_seed = (int(body["seed"])
+                    if body.get("seed") is not None else None)
+        markers = () if raw else CHAT_EOS_MARKERS
+        tail_len = max([len(m) for m in markers]
+                       + [len(s) for s in stops] + [1]) + 16
+        prev = [r[-1] for r in rows]
+    except PromptTooLong:
+        raise
+    except (ValueError, TypeError, KeyError, AttributeError) as e:
+        raise BadRequest(f"{type(e).__name__}: {e}") from e
     tails = [""] * b
     emitted = [0] * b
     finish = ["length"] * b
@@ -423,22 +468,6 @@ def _batch_completion_chunks(state: ApiState, body: dict):
     rows = rows + [[rows[0][0]]] * n_pad
     stop_flags = np.zeros(engine.batch, bool)
     stop_flags[b:] = True
-
-    # borrow the scheduler's engine for the whole-batch run: exclusive()
-    # drains in-flight slot requests, then blocks the step loop until the
-    # finally below releases it (entered/exited manually so the existing
-    # try/finally keeps its shape — a generator teardown mid-stream still
-    # reaches the finally and releases the scheduler). Nothing between
-    # here and the try may raise: everything fallible was parsed above.
-    _excl = sched.exclusive()
-    _excl.__enter__()
-    saved_temp = sampler.temperature
-    saved_rng_state = None
-    if req_temp is not None:
-        sampler.set_temp(req_temp)
-    if req_seed is not None:
-        saved_rng_state = sampler.rng_state
-        sampler.set_seed(req_seed)
 
     def scan_token(i: int, tok: int) -> str | None:
         """Shared per-token body of both batch paths: eos / marker /
@@ -459,44 +488,59 @@ def _batch_completion_chunks(state: ApiState, body: dict):
         emitted[i] += 1
         return piece
 
-    try:
-        engine.reset()  # slots are drained; the borrowed cache starts clean
-        if state.lookup_decode > 0 and sampler.temperature == 0.0:
-            # greedy batch requests SPECULATE (Engine.generate_batch_lookup
-            # — per-row drafts, one verify forward per step, exact per-row
-            # greedy parity; bench measured 368-407 aggregate tok/s vs 355
-            # plain-batch). Collected, not streamed: text-level stop
-            # sequences trim each row post-hoc — a stopped row may have
-            # burned some extra forwards, which multi-token accepts more
-            # than repay; the batch cache resets per request, so the
-            # overrun positions leak nothing
-            outs = engine.generate_batch_lookup(
-                rows, n_gen, eos_id=tokenizer.eos_id,
-                draft_len=state.lookup_decode,
-                vocab_size=tokenizer.vocab_size, stop_flags=stop_flags)
-            for i in range(b):
-                for tok in outs[i]:
-                    piece = scan_token(i, tok)
-                    if piece is None:
-                        break
-                    yield ("piece", (i, piece))
-        else:
-            for step in engine.generate_batch_stream(
-                    rows, n_gen, sampler, stop_flags=stop_flags):
-                for i, tok in enumerate(step):
-                    if tok is None or stop_flags[i]:
-                        continue
-                    piece = scan_token(i, tok)
-                    if piece is None:
-                        stop_flags[i] = True
-                        continue
-                    yield ("piece", (i, piece))
-    finally:
-        sampler.set_temp(saved_temp)
-        if saved_rng_state is not None:
-            sampler.rng_state = saved_rng_state
-        engine.reset()  # the batch cache holds nothing reusable
-        _excl.__exit__(None, None, None)  # hand the engine back
+    # borrow the scheduler's engine for the whole-batch run: exclusive()
+    # drains in-flight slot requests, then blocks the step loop until the
+    # block exits. A real `with` (not manual __enter__/__exit__(None,..)):
+    # a crash inside the borrow must propagate THROUGH the supervised
+    # context manager so EngineSupervisor recovery runs, and a generator
+    # teardown mid-stream (GeneratorExit) still unwinds it and releases
+    # the scheduler. Everything fallible was parsed above.
+    with sched.exclusive():
+        saved_temp = sampler.temperature
+        saved_rng_state = None
+        if req_temp is not None:
+            sampler.set_temp(req_temp)
+        if req_seed is not None:
+            saved_rng_state = sampler.rng_state
+            sampler.set_seed(req_seed)
+        try:
+            engine.reset()  # slots drained; the borrowed cache starts clean
+            if state.lookup_decode > 0 and sampler.temperature == 0.0:
+                # greedy batch requests SPECULATE
+                # (Engine.generate_batch_lookup — per-row drafts, one
+                # verify forward per step, exact per-row greedy parity;
+                # bench measured 368-407 aggregate tok/s vs 355
+                # plain-batch). Collected, not streamed: text-level stop
+                # sequences trim each row post-hoc — a stopped row may
+                # have burned some extra forwards, which multi-token
+                # accepts more than repay; the batch cache resets per
+                # request, so the overrun positions leak nothing
+                outs = engine.generate_batch_lookup(
+                    rows, n_gen, eos_id=tokenizer.eos_id,
+                    draft_len=state.lookup_decode,
+                    vocab_size=tokenizer.vocab_size, stop_flags=stop_flags)
+                for i in range(b):
+                    for tok in outs[i]:
+                        piece = scan_token(i, tok)
+                        if piece is None:
+                            break
+                        yield ("piece", (i, piece))
+            else:
+                for step in engine.generate_batch_stream(
+                        rows, n_gen, sampler, stop_flags=stop_flags):
+                    for i, tok in enumerate(step):
+                        if tok is None or stop_flags[i]:
+                            continue
+                        piece = scan_token(i, tok)
+                        if piece is None:
+                            stop_flags[i] = True
+                            continue
+                        yield ("piece", (i, piece))
+        finally:
+            sampler.set_temp(saved_temp)
+            if saved_rng_state is not None:
+                sampler.rng_state = saved_rng_state
+            engine.reset()  # the batch cache holds nothing reusable
     yield ("done", {
         "finish_reasons": finish,
         "prompt_tokens": n_prompt_toks,
@@ -588,11 +632,17 @@ def make_handler(state: ApiState):
         def log_message(self, fmt, *fargs):  # quiet
             pass
 
-        def _json(self, code: int, obj: dict) -> None:
+        def _json(self, code: int, obj: dict,
+                  retry_after: float | None = None) -> None:
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if retry_after is not None:
+                # overload/recovery rejections tell the client WHEN to come
+                # back instead of letting it hammer or queue unboundedly
+                self.send_header("Retry-After",
+                                 str(max(1, int(round(retry_after)))))
             self.end_headers()
             self.wfile.write(data)
 
@@ -618,8 +668,15 @@ def make_handler(state: ApiState):
                 self._json(200, {"object": "list", "data": [
                     {"id": state.model_name, "object": "model",
                      "created": int(time.time()), "owned_by": "user"}]})
-            elif self.path in ("/", "/health"):
-                self._json(200, {"status": "ok"})
+            elif self.path in ("/", "/health", "/healthz"):
+                # liveness: the process is up and serving HTTP — true even
+                # while the engine recovers (that is /readyz's business) or
+                # the server drains (it reports so, but stays 200: a
+                # liveness-restart would cut the drain short)
+                self._json(200, {"status": "draining" if state.draining
+                                 else "ok"})
+            elif self.path == "/readyz":
+                self._readyz()
             elif self.path == "/stats":
                 # serving observability: TTFT/ITL percentiles, slot
                 # occupancy, queue depth (runtime/stats.ServeStats). A
@@ -630,14 +687,46 @@ def make_handler(state: ApiState):
                 elif state._scheduler is None:
                     self._json(200, {"scheduler": "idle"})
                 else:
-                    self._json(200, state._scheduler.stats.summary())
+                    # supervisor summary: scheduler counters (totals carried
+                    # across recoveries) + the resilience block
+                    self._json(200, state._scheduler.summary())
             else:
                 self._json(404, {"error": "not found"})
+
+        def _readyz(self) -> None:
+            """Readiness = engine healthy AND queue under bound (and not
+            draining). 503 + Retry-After otherwise — the load balancer's
+            signal to route elsewhere."""
+            if state.draining:
+                self._json(503, {"status": "draining"}, retry_after=1.0)
+            elif state.serve_batch <= 0:
+                # legacy single-engine server: always ready (requests
+                # serialize behind engine_lock, no supervised loop)
+                self._json(200, {"status": "ready", "scheduler": "off"})
+            elif state._scheduler is None:
+                # supervisor builds on first request; a readiness probe
+                # must not be the thing that allocates the batched cache
+                self._json(200, {"status": "ready", "scheduler": "idle"})
+            else:
+                sup = state._scheduler
+                if sup.ready:
+                    self._json(200, {"status": "ready",
+                                     "state": sup.state})
+                else:
+                    self._json(503, {"status": "unready",
+                                     "state": sup.state},
+                               retry_after=sup._retry_after())
 
         def do_POST(self):
             if self.path not in ("/v1/chat/completions", "/v1/completions",
                                  "/v1/batch/completions"):
                 self._json(404, {"error": "not found"})
+                return
+            if state.draining:
+                # graceful drain: in-flight requests finish, NEW work is
+                # refused fast so the client retries a live replica
+                self._json(503, {"error": "server draining"},
+                           retry_after=2.0)
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -665,8 +754,21 @@ def make_handler(state: ApiState):
             gen = _batch_completion_chunks(state, body)
             try:
                 first = next(gen)
-            except PromptTooLong as e:
+            except (PromptTooLong, BadRequest) as e:
                 self._json(400, {"error": str(e)})
+                return
+            except EngineUnready as e:
+                # the exclusive borrow is refused while recovering/draining
+                self._json(503, {"error": str(e), "state": e.state},
+                           retry_after=e.retry_after)
+                return
+            except Exception as e:  # noqa: BLE001 — a crash inside the
+                # borrow already triggered supervisor recovery (resilience
+                # .exclusive); the client gets a retryable 503, not a
+                # dropped connection
+                self._json(503, {"error": f"engine failure: "
+                                          f"{type(e).__name__}: {e}"},
+                           retry_after=1.0)
                 return
 
             def events():
@@ -742,6 +844,16 @@ def make_handler(state: ApiState):
                 except PromptTooLong as e:
                     self._json(400, {"error": str(e)})
                     return
+                except QueueFull as e:
+                    # admission control: overload is a FAST 429, not an
+                    # unboundedly growing queue
+                    self._json(429, {"error": str(e)},
+                               retry_after=e.retry_after)
+                    return
+                except EngineUnready as e:
+                    self._json(503, {"error": str(e), "state": e.state},
+                               retry_after=e.retry_after)
+                    return
 
                 def events():
                     yield first
@@ -784,6 +896,11 @@ def make_handler(state: ApiState):
                                 usage = payload
                     finally:
                         drain()
+                    if usage.get("error"):
+                        # mid-stream failure: the client gets an EXPLICIT
+                        # structured error event and a terminated stream
+                        # (finish_reason "error"), never a silent hang
+                        self._sse({"error": usage["error"]})
                     self._sse(final_env(usage["finish_reason"]))
                     self._sse_done()
                     return
@@ -799,6 +916,12 @@ def make_handler(state: ApiState):
                             usage = payload
                 finally:
                     drain()
+                if usage.get("error") and not text:
+                    # failed before any output: a clean retryable status
+                    # beats a 200 carrying an empty completion
+                    self._json(503, {"error": usage["error"]},
+                               retry_after=1.0)
+                    return
                 if chat:
                     self._json(200, _completion_env(
                         rid, created, state.model_name,
@@ -827,13 +950,6 @@ def serve(args) -> None:
 
     session = getattr(args, "session", None)
     check_session_flags(args)
-    if session and threading.current_thread() is threading.main_thread():
-        # non-interactive shutdown (docker stop, systemd) sends SIGTERM,
-        # whose default handler exits WITHOUT unwinding the stack — the
-        # finally below would never save. Convert it to SystemExit so the
-        # save runs for service deployments too.
-        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
-
     serve_batch = getattr(args, "serve_batch", 0)
     if serve_batch:
         # the scheduler's batch engine is single-process/single-device by
@@ -855,7 +971,10 @@ def serve(args) -> None:
     state = ApiState(engine, tokenizer, sampler,
                      lookup_decode=getattr(args, "lookup_decode", 0),
                      serve_batch=serve_batch,
-                     serve_chunk=getattr(args, "serve_chunk", 0))
+                     serve_chunk=getattr(args, "serve_chunk", 0),
+                     queue_depth=getattr(args, "queue_depth", 0),
+                     request_deadline=getattr(args, "request_deadline", 0.0),
+                     stall_timeout=getattr(args, "stall_timeout", 0.0))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "
@@ -865,14 +984,37 @@ def serve(args) -> None:
     # serialize on state.engine_lock / Scheduler.exclusive
     server = ThreadingHTTPServer((args.host, args.port),
                                  make_handler(state))
+    drain_timeout = getattr(args, "drain_timeout", 30.0)
+
+    def _begin_drain(*_):
+        # graceful drain (SIGTERM — docker stop, k8s rollout, systemd):
+        # stop admitting (POSTs 503, /readyz unready), stop accepting,
+        # let serve_forever return; the finally below finishes in-flight
+        # work up to --drain-timeout, saves the session, and exits. The
+        # default SIGTERM handler would exit WITHOUT unwinding the stack
+        # — no drain, no save.
+        state.draining = True
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, _begin_drain)
     print(f"🔌 dllama-api listening on {args.host}:{args.port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        state.draining = True
         server.server_close()
         if state._scheduler is not None:
+            # finish in-flight/queued scheduler work before exiting; past
+            # the deadline, close() fails stragglers with structured
+            # shutdown frames (no waiter ever hangs on a dead process)
+            if state._scheduler.drain(timeout=drain_timeout):
+                print("🔌 drained: all in-flight requests completed")
+            else:
+                print(f"🔌 drain deadline ({drain_timeout:.0f}s) elapsed; "
+                      "failing stragglers")
             state._scheduler.close()
         if session:
             if save_server_session(state, session):

@@ -142,6 +142,33 @@ def build_argparser() -> argparse.ArgumentParser:
                         "Smaller C bounds the inter-token stall admission "
                         "adds to running requests; larger C prefills new "
                         "prompts in fewer steps (docs/serving.md)")
+    # serving-resilience flags (api mode; runtime/resilience.py,
+    # docs/operations.md)
+    p.add_argument("--queue-depth", type=int, default=0, metavar="N",
+                   help="api mode: bound the scheduler admission queue at "
+                        "N waiting requests — overload returns HTTP 429 + "
+                        "Retry-After instead of queueing unboundedly "
+                        "(0 = 4x --serve-batch)")
+    p.add_argument("--request-deadline", type=float, default=0.0,
+                   metavar="SECS",
+                   help="api mode: per-request end-to-end budget; a "
+                        "request past it (queued or mid-decode) fails "
+                        "fast with a structured 'deadline' error frame "
+                        "(0 = off)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   metavar="SECS",
+                   help="api mode: watchdog bound on one scheduler step — "
+                        "a step stalled longer (the TPU-tunnel hang "
+                        "signature) marks the engine unhealthy and "
+                        "triggers recovery (0 = default 10; must exceed "
+                        "the worst-case step, compiles are warmed off "
+                        "the clock)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   metavar="SECS",
+                   help="api mode: graceful-drain budget on SIGTERM — "
+                        "admissions stop immediately, in-flight requests "
+                        "get this long to finish before being failed "
+                        "with structured shutdown frames")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,

@@ -1,6 +1,11 @@
 from .engine import Engine, GenerationResult
-from .scheduler import PromptTooLong, Scheduler, ServeRequest
-from .stats import ServeStats, StepStats
+from .faults import FAULTS, FaultError
+from .resilience import EngineSupervisor, EngineUnready
+from .scheduler import (PromptTooLong, QueueFull, RequestError, Scheduler,
+                        SchedulerClosed, ServeRequest)
+from .stats import ServeStats, StepStats, SupervisorStats
 
 __all__ = ["Engine", "GenerationResult", "PromptTooLong", "Scheduler",
-           "ServeRequest", "ServeStats", "StepStats"]
+           "ServeRequest", "ServeStats", "StepStats", "FAULTS",
+           "FaultError", "EngineSupervisor", "EngineUnready", "QueueFull",
+           "RequestError", "SchedulerClosed", "SupervisorStats"]

@@ -325,21 +325,26 @@ class Engine:
         assert pos <= self.seq_len
         self.reset()
         dt = jnp.dtype(self.cache_dtype)
+        # build each restored row ON DEVICE (fresh zeros + scatter of the
+        # saved prefix) so the buffer is XLA-owned. Wholesale
+        # device_put/asarray of a host temporary here produced buffers
+        # whose DONATION into the first jitted step intermittently yielded
+        # NaN-poisoned garbage on the CPU backend (the
+        # test_api_session_survives_restart flake — use-after-free of the
+        # host staging memory); a computed output can never alias host
+        # memory, so donating it is safe. out_shardings materializes the
+        # full-seq_len result straight into the sharded layout — no
+        # device ever holds a whole unsharded row (only the transient
+        # prefix input is replicated).
+        shape = (self.batch, self.spec.n_kv_heads, self.seq_len,
+                 self.spec.head_size)
+        build = jax.jit(
+            lambda pfx: jnp.zeros(shape, dt).at[:, :, :pos, :].set(pfx),
+            out_shardings=self._cache_sharding)
         k_all, v_all = [], []
         for l in range(self.spec.n_layers):
-            host = {}
-            for name in ("k", "v"):
-                full = np.zeros(
-                    (self.batch, self.spec.n_kv_heads, self.seq_len,
-                     self.spec.head_size), dt)
-                full[:, :, :pos, :] = z[f"{name}{l}"].view(dt)
-                host[name] = full
-            if self._cache_sharding is not None:
-                k_all.append(jax.device_put(host["k"], self._cache_sharding))
-                v_all.append(jax.device_put(host["v"], self._cache_sharding))
-            else:
-                k_all.append(jnp.asarray(host["k"]))
-                v_all.append(jnp.asarray(host["v"]))
+            k_all.append(build(z[f"k{l}"].view(dt)))
+            v_all.append(build(z[f"v{l}"].view(dt)))
         self.cache = KVCache(tuple(k_all), tuple(v_all))
         self.pos = pos
         return z["tokens"].tolist() if "tokens" in z.files else []
@@ -958,6 +963,10 @@ class Engine:
         executables (the fixed-compilation-key discipline dlgrind DLG204
         pins). Does NOT touch self.pos — per-slot positions are owned by
         the scheduler."""
+        from .faults import FAULTS
+
+        FAULTS.fire("prefill_raise")  # injection point: host-side, before
+        # any dispatch — arming it never alters the jitted program
         b, c = tokens.shape
         assert b == self.batch, (b, self.batch)
         key = ("slot_prefill", c)

@@ -1,0 +1,152 @@
+"""Deterministic fault injection for the serving stack.
+
+The reference engine has no fault tolerance (SURVEY §"no fault tolerance"),
+and the real failure shapes this repo has hit are not reproducible at will:
+the TPU plugin HANGS (not errors) when its tunnel is down, and a mid-decode
+crash poisons the donated KV cache. This registry makes every one of those
+shapes a one-line, count-deterministic trigger so the whole resilience
+layer (runtime/resilience.py) is testable in CI on CPU.
+
+Named sites, fired host-side BEFORE any device dispatch (so arming a fault
+never changes a jitted program — the dlgrind entry-point fingerprints are
+invariant under injection):
+
+  * ``step_raise``    — scheduler step loop, start of an iteration: raises
+                        ``FaultError`` (the crash shape)
+  * ``step_stall``    — same place: blocks for ``ms`` milliseconds or until
+                        ``release()`` (the axon-hang shape — a watchdog must
+                        detect it, nothing else will)
+  * ``prefill_raise`` — Engine.slot_prefill_chunk entry: raises
+                        ``FaultError`` mid-admission
+  * ``slow_step``     — scheduler step loop: sleeps ``ms`` per fire (the
+                        degraded-but-alive shape deadlines must catch)
+
+Arming is test-driven (``FAULTS.arm(...)``) or env-driven for subprocess
+harnesses (bench chaos rows, CI):
+
+    DLLAMA_FAULTS="step_raise:after=40;times=1,slow_step:ms=50;times=0"
+
+``after=N`` skips the first N invocations of the site, ``times=K`` fires on
+the next K (K=0 → every invocation), ``ms=F`` sets the stall/sleep length.
+Counters are per-site and monotonically increasing, so a given arm spec
+fires at exactly the same invocations on every run — crashes land on the
+same scheduler iteration every time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step")
+
+
+class FaultError(RuntimeError):
+    """The injected failure (distinct type so tests can tell an injected
+    crash from a real one)."""
+
+
+@dataclasses.dataclass
+class _Armed:
+    site: str
+    after: int = 0     # skip this many invocations of the site first
+    times: int = 1     # then fire on this many (0 = every one from there on)
+    ms: float = 0.0    # stall/sleep milliseconds (step_stall / slow_step)
+    hits: int = 0      # invocations seen
+    fired: int = 0     # invocations that actually fired
+
+    def should_fire(self) -> bool:
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultRegistry:
+    """Thread-safe, count-deterministic fault trigger store. One process
+    singleton (``FAULTS``); the scheduler/engine call ``fire(site)`` at the
+    named sites and pay one dict lookup when nothing is armed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Armed] = {}
+        # a stalled site blocks on this event, so tests can release a
+        # "hung" thread instead of leaking it for the stall duration
+        self._release = threading.Event()
+
+    def arm(self, site: str, *, after: int = 0, times: int = 1,
+            ms: float = 0.0) -> None:
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (have {SITES})")
+        with self._lock:
+            self._release.clear()
+            self._armed[site] = _Armed(site, after=after, times=times, ms=ms)
+
+    def clear(self, site: str | None = None) -> None:
+        """Disarm (one site or everything) and release any in-progress
+        stall — test teardown must never leave a thread blocked."""
+        with self._lock:
+            if site is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(site, None)
+            self._release.set()
+
+    def release(self) -> None:
+        """Unblock any thread currently inside a ``step_stall``."""
+        self._release.set()
+
+    def armed(self, site: str) -> bool:
+        with self._lock:
+            return site in self._armed
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            a = self._armed.get(site)
+            return a.fired if a else 0
+
+    def fire(self, site: str) -> None:
+        """Called at the named site. No-op unless armed; otherwise raises
+        (``*_raise``), stalls (``step_stall``) or sleeps (``slow_step``)
+        per the armed spec."""
+        with self._lock:
+            a = self._armed.get(site)
+            if a is None or not a.should_fire():
+                return
+            ms = a.ms
+        if site.endswith("_raise"):
+            raise FaultError(f"injected {site} (fire #{a.fired})")
+        if site == "step_stall":
+            # block like the real hang: until released or ms elapses
+            # (default: effectively forever — the watchdog's job)
+            self._release.wait(timeout=(ms / 1e3) if ms else 3600.0)
+            return
+        if site == "slow_step" and ms:
+            import time
+
+            time.sleep(ms / 1e3)
+
+    def load_env(self, env=None) -> None:
+        """Parse ``DLLAMA_FAULTS`` (see module docstring). Malformed specs
+        raise ValueError loudly — a typo'd chaos run must not silently
+        measure a healthy system."""
+        spec = (env if env is not None else os.environ).get(
+            "DLLAMA_FAULTS", "")
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            site, _, opts = part.partition(":")
+            kw: dict = {}
+            for opt in filter(None, (o.strip() for o in opts.split(";"))):
+                key, _, val = opt.partition("=")
+                if key not in ("after", "times", "ms"):
+                    raise ValueError(
+                        f"bad DLLAMA_FAULTS option {opt!r} in {part!r}")
+                kw[key] = float(val) if key == "ms" else int(val)
+            self.arm(site, **kw)
+
+
+FAULTS = FaultRegistry()
+FAULTS.load_env()

@@ -52,11 +52,44 @@ from typing import Iterator
 
 import numpy as np
 
+from .faults import FAULTS
 from .stats import RequestStats, ServeStats
 
 
 class PromptTooLong(ValueError):
     """Prompt does not fit the engine's context window."""
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the request queue is at its configured bound.
+    Overload must surface as a FAST structured rejection (HTTP 429 with
+    Retry-After at the API layer), never as unbounded queue latency."""
+
+    def __init__(self, depth: int, bound: int, retry_after: float = 1.0):
+        super().__init__(f"queue full ({depth} waiting, bound {bound})")
+        self.retry_after = retry_after
+
+
+class SchedulerClosed(RuntimeError):
+    """Submission after close(): the step loop is gone, so queueing the
+    request would hang its waiter forever."""
+
+
+class RequestError(RuntimeError):
+    """Structured terminal failure of one request — the payload every
+    error frame carries: a machine-readable ``code`` plus whether a
+    client retry is expected to succeed (``retryable``). Raised out of
+    ``ServeRequest.tokens()`` so stream consumers see one exception type
+    with the frame attached."""
+
+    def __init__(self, code: str, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.code = code
+        self.retryable = retryable
+
+    def frame(self) -> dict:
+        return {"code": self.code, "message": str(self),
+                "retryable": self.retryable}
 
 
 class ServeRequest:
@@ -70,33 +103,60 @@ class ServeRequest:
     text-level stop sequences and client disconnects)."""
 
     def __init__(self, rid: int, prompt: list[int], max_tokens: int,
-                 sampler, stop_ids: set[int]):
+                 sampler, stop_ids: set[int],
+                 deadline: float | None = None):
         self.id = rid
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.sampler = sampler
         self.stop_ids = stop_ids
+        # absolute time.perf_counter() bound: past it the request is
+        # failed with a structured "deadline" frame wherever it sits
+        # (queued or mid-decode) — overload degrades to fast rejections
+        self.deadline = deadline
         self.events: _queue.Queue = _queue.Queue()
         self.finished = threading.Event()
         self.finish_reason: str | None = None
         self.stats = RequestStats(n_prompt=len(prompt))
         self._cancelled = False
+        self._terminal_lock = threading.Lock()
+        self._terminal = False
+
+    def _claim_terminal(self) -> bool:
+        """Exactly-once guard for the terminal event: concurrent failure
+        paths (a dying generation's _abort_all racing the supervisor's
+        failed-during-submit fallback, close() racing a wedged step) may
+        BOTH try to finish a request; only the first claim delivers the
+        event and counts in the stats."""
+        with self._terminal_lock:
+            if self._terminal:
+                return False
+            self._terminal = True
+            return True
 
     def cancel(self) -> None:
         self._cancelled = True
 
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
     def tokens(self, timeout: float = 600.0) -> Iterator[int]:
         """Yield generated token ids until the terminal event. `timeout`
         bounds the wait per event so a dead scheduler thread surfaces as
-        an error instead of a hung consumer."""
+        an error instead of a hung consumer. Error frames raise
+        ``RequestError`` with the structured payload attached."""
         while True:
             kind, val = self.events.get(timeout=timeout)
             if kind == "token":
                 yield val
             elif kind == "done":
                 return
-            else:
-                raise RuntimeError(f"scheduler error: {val}")
+            elif isinstance(val, dict):
+                raise RequestError(val.get("code", "error"),
+                                   val.get("message", "scheduler error"),
+                                   val.get("retryable", True))
+            else:  # legacy bare-string frame
+                raise RequestError("error", f"scheduler error: {val}")
 
 
 class _Slot:
@@ -116,11 +176,21 @@ class _Slot:
 
 
 class Scheduler:
-    def __init__(self, engine, *, chunk: int | None = None):
+    def __init__(self, engine, *, chunk: int | None = None,
+                 max_queue: int = 0, queue_timeout: float | None = None,
+                 request_deadline: float | None = None):
         self.engine = engine
         self.chunk = int(chunk or min(engine.prefill_chunk, engine.seq_len))
         assert 1 <= self.chunk <= engine.seq_len, self.chunk
         self.slots = [_Slot(i) for i in range(engine.batch)]
+        # admission control: max_queue bounds the waiting line (0 = no
+        # bound — the supervisor/API layer sets one); queue_timeout bounds
+        # how long a request may WAIT before it must be failed rather than
+        # started; request_deadline is the default per-request end-to-end
+        # budget applied at submit when the caller gives none
+        self.max_queue = int(max_queue)
+        self.queue_timeout = queue_timeout
+        self.request_deadline = request_deadline
         # deque.append/popleft are atomic under the GIL, so submit() never
         # touches the step mutex: a submitter must not wait out an
         # in-flight forward (measured: mutex-taking submits stalled a
@@ -132,19 +202,32 @@ class Scheduler:
         self.stats = ServeStats()
         self._thread: threading.Thread | None = None
         self._stop = False
+        self._closed = False
+        # watchdog heartbeat: perf_counter when the CURRENT step body
+        # entered, None while idle/between steps. Written only by the
+        # stepping thread; read lock-free by the supervisor's watchdog
+        # (a float store is atomic under the GIL) — a mutex-holding
+        # borrow (exclusive()) therefore never looks like a stall.
+        self._step_t0: float | None = None
         self._rid = 0
         self._rid_lock = threading.Lock()
 
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt: list[int], max_tokens: int, sampler,
-               eos_id: int | set[int] | None = None) -> ServeRequest:
+               eos_id: int | set[int] | None = None,
+               deadline: float | None = None) -> ServeRequest:
         """Enqueue a request; it joins the running batch as soon as a slot
         frees. `sampler` is PER REQUEST (its RNG stream is the slot's
         sampling state — concurrent requests never share coins).
         max_tokens <= 0 prefills and emits nothing (Engine.generate's
         hard-cap contract). Raises PromptTooLong before queueing when the
-        prompt cannot fit the context."""
+        prompt cannot fit the context, QueueFull when the waiting line is
+        at max_queue, SchedulerClosed after close(). `deadline` is an
+        absolute perf_counter bound (default: now + request_deadline when
+        configured)."""
+        if self._closed:
+            raise SchedulerClosed("scheduler is closed")
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -152,18 +235,34 @@ class Scheduler:
             raise PromptTooLong(
                 f"prompt is {len(prompt)} tokens; context is "
                 f"{self.engine.seq_len}")
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            with self._rid_lock:
+                self.stats.requests_rejected += 1
+            raise QueueFull(len(self._queue), self.max_queue)
         stop_ids = ({eos_id} if isinstance(eos_id, int)
                     else set(eos_id or ()))
+        now = time.perf_counter()
+        if deadline is None and self.request_deadline is not None:
+            deadline = now + self.request_deadline
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
-        req = ServeRequest(rid, prompt, max_tokens, sampler, stop_ids)
-        req.stats.t_submit = time.perf_counter()
+        req = ServeRequest(rid, prompt, max_tokens, sampler, stop_ids,
+                           deadline=deadline)
+        req.stats.t_submit = now
         with self._rid_lock:
             self.stats.requests_submitted += 1
         self.stats.requests.append(req.stats)  # deque.append: atomic
         self._queue.append(req)
         self._wake.set()
+        if self._closed:
+            # close() ran between the entry check and the append: its
+            # _abort_all may already have drained the queue, so this
+            # request would hang its waiter forever — fail it here
+            # (idempotent: if the abort DID see it, the claim loses)
+            self._fail_req(req, {"code": "shutdown",
+                                 "message": "scheduler shutdown",
+                                 "retryable": False})
         return req
 
     # -- the scheduling iteration -----------------------------------------
@@ -183,12 +282,40 @@ class Scheduler:
                                             for s in self.slots)
 
     def _step_locked(self) -> bool:
-        # reap cancellations FIRST so a disconnected client's request never
-        # burns another forward — in particular a long prompt must not
-        # prefill its remaining chunks into a dead slot
+        self._step_t0 = time.perf_counter()  # watchdog heartbeat: in-step
+        try:
+            return self._step_body()
+        finally:
+            self._step_t0 = None
+
+    def _step_body(self) -> bool:
+        if not self._queue and all(s.req is None for s in self.slots):
+            # idle iteration: nothing to do AND no fault site fires — an
+            # armed fault must land on a WORKING step (a crash on an idle
+            # loop is meaningless, and another scheduler's idle loop in
+            # the same process must never consume a globally-armed fault
+            # out from under the one being tested)
+            return False
+        # named fault sites (runtime/faults.py): no-ops unless armed; fired
+        # BEFORE any device dispatch so injection never alters a jitted
+        # program (the dlgrind fingerprints are injection-invariant)
+        FAULTS.fire("step_raise")
+        FAULTS.fire("step_stall")
+        FAULTS.fire("slow_step")
+        now = time.perf_counter()
+        # reap cancellations and expired deadlines FIRST so a disconnected
+        # client's request never burns another forward — in particular a
+        # long prompt must not prefill its remaining chunks into a dead
+        # slot — and an over-deadline request fails NOW, not after its
+        # budget drains
         for s in self.slots:
-            if s.req is not None and s.req._cancelled:
+            if s.req is None:
+                continue
+            if s.req._cancelled:
                 self._finish_slot(s, "cancelled")
+            elif s.req.expired(now):
+                req, s.req = s.req, None
+                self._expire_req(req)
         self._admit()
         pre = [s for s in self.slots
                if s.req is not None and s.off < len(s.req.prompt)]
@@ -208,12 +335,31 @@ class Scheduler:
             self._decode(dec)
         return True
 
+    def _expire_req(self, req: ServeRequest, code: str = "deadline",
+                    message: str = "request deadline exceeded") -> None:
+        """Fail one request with a structured expiry frame."""
+        if self._fail_req(req, {"code": code, "message": message,
+                                "retryable": code != "deadline"}):
+            self.stats.requests_expired += 1
+
     def _admit(self) -> None:
+        now = time.perf_counter()
         free = [s for s in self.slots if s.req is None]
         while free and self._queue:
             req = self._queue.popleft()
             if req._cancelled:
                 self._finish_req(req, "cancelled")
+                continue
+            if req.expired(now):
+                self._expire_req(req)
+                continue
+            if (self.queue_timeout is not None
+                    and now - req.stats.t_submit > self.queue_timeout):
+                # queue-time budget: a request that waited too long is
+                # failed at admission instead of started late — its waiter
+                # gets a fast structured rejection it can retry elsewhere
+                self._expire_req(req, code="queue_timeout",
+                                 message="queue-time budget exceeded")
                 continue
             s = free.pop(0)
             s.req = req
@@ -299,11 +445,30 @@ class Scheduler:
         self._finish_req(req, reason)
 
     def _finish_req(self, req: ServeRequest, reason: str) -> None:
+        if not req._claim_terminal():
+            return
         req.finish_reason = reason
         req.stats.t_done = time.perf_counter()
         self.stats.requests_finished += 1
         req.events.put(("done", reason))
         req.finished.set()
+
+    def warmup(self) -> None:
+        """Compile the serving executables (slot_prefill_chunk_C and
+        slot_decode_step) by running each once with EVERY row gated off
+        (pos == seq_len: cache writes drop out of bounds, logits unread) —
+        state-neutral by the same invariant the scheduler always relies
+        on. The supervisor runs this on a rebuilt engine BEFORE marking it
+        ready, so first-step compile time is spent while the watchdog is
+        not watching; without it a stall_timeout below the compile time
+        would trip on every fresh engine's first real step (an infinite
+        recovery loop on TPU, where compiles run tens of seconds)."""
+        eng = self.engine
+        with self._mutex:
+            gate = np.full((eng.batch,), eng.seq_len, np.int32)
+            eng.slot_prefill_chunk(np.zeros((eng.batch, self.chunk), np.int32),
+                                   gate, np.zeros((eng.batch,), np.int32))
+            eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32), gate)
 
     # -- background thread -------------------------------------------------
 
@@ -331,27 +496,55 @@ class Scheduler:
             if not did and not self._stop:
                 self._wake.wait(timeout=0.05)
 
-    def _abort_all(self, msg: str) -> None:
-        def fail(req: ServeRequest) -> None:
-            req.finish_reason = "error"
-            req.stats.t_done = time.perf_counter()
-            self.stats.requests_finished += 1
-            req.events.put(("error", msg))
-            req.finished.set()
+    def _fail_req(self, req: ServeRequest, frame: dict) -> bool:
+        """Terminal structured-error delivery for one request
+        (exactly-once: concurrent failure paths both calling this deliver
+        one event and count one failure). Returns whether THIS call won
+        the claim."""
+        if not req._claim_terminal():
+            return False
+        req.finish_reason = "error"
+        req.stats.t_done = time.perf_counter()
+        self.stats.requests_finished += 1
+        self.stats.requests_failed += 1
+        req.events.put(("error", dict(frame)))
+        req.finished.set()
+        return True
 
+    def _abort_all(self, msg: str, code: str = "engine_error",
+                   retryable: bool = True) -> None:
+        """Fail every in-flight and queued request with one structured
+        frame. Called WITHOUT the mutex from close()/the supervisor when
+        the step thread may be wedged inside a forward holding it — slot
+        hand-off here races only against that dead/stuck thread, whose
+        scheduler generation is already discarded."""
+        frame = {"code": code, "message": msg, "retryable": retryable}
         for s in self.slots:
             if s.req is not None:
                 req, s.req = s.req, None
-                fail(req)
+                self._fail_req(req, frame)
         while self._queue:
-            fail(self._queue.popleft())
+            try:
+                self._fail_req(self._queue.popleft(), frame)
+            except IndexError:  # racing submit/abort: queue drained under us
+                break
 
     def close(self, timeout: float = 30.0) -> None:
+        """Stop the loop and FAIL whatever is still queued or in flight —
+        a submitter blocked in ServeRequest.tokens() must get its terminal
+        frame now, not a 600 s timeout (pre-fix, close() left queued
+        requests un-failed and their waiters hanging)."""
+        self._closed = True  # new submits raise SchedulerClosed
         self._stop = True
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        # no mutex: a cleanly-joined thread is gone; a stuck one (hung
+        # forward) holds the mutex forever and the waiters still need
+        # their frames
+        self._abort_all("scheduler shutdown", code="shutdown",
+                        retryable=False)
 
     @contextlib.contextmanager
     def exclusive(self):

@@ -95,6 +95,14 @@ class ServeStats:
     requests_finished: int = 0
     tokens_out: int = 0
     steps: int = 0
+    # resilience counters (requests_failed/expired also count toward
+    # requests_finished — every submitted request gets exactly one
+    # terminal event): failed = structured error frames (crash/abort),
+    # expired = deadline or queue-time budget kills, rejected = refused
+    # at submit() (queue bound) and therefore NOT in requests_submitted
+    requests_failed: int = 0
+    requests_expired: int = 0
+    requests_rejected: int = 0
 
     def __post_init__(self):
         from collections import deque
@@ -113,6 +121,9 @@ class ServeStats:
         return {
             "requests_submitted": self.requests_submitted,
             "requests_finished": self.requests_finished,
+            "requests_failed": self.requests_failed,
+            "requests_expired": self.requests_expired,
+            "requests_rejected": self.requests_rejected,
             "tokens_out": self.tokens_out,
             "ttft_p50_ms": rnd(percentile(ttfts, 50)),
             "ttft_p99_ms": rnd(percentile(ttfts, 99)),
@@ -123,4 +134,36 @@ class ServeStats:
             if self.occupancy else 0.0,
             "max_queue_depth": max(self.queue_depth, default=0),
             "steps": self.steps,
+        }
+
+
+@dataclasses.dataclass
+class SupervisorStats:
+    """Resilience counters owned by runtime/resilience.EngineSupervisor —
+    they survive scheduler rebuilds (each recovery mints a fresh
+    Scheduler/ServeStats; these accumulate across generations)."""
+
+    crashes: int = 0          # step-loop exceptions caught
+    watchdog_trips: int = 0   # stalls detected by the watchdog
+    recoveries: int = 0       # successful rebuilds back to ready
+    consecutive_failures: int = 0
+    rejected_unready: int = 0  # submits refused while recovering/broken
+
+    def __post_init__(self):
+        from collections import deque
+
+        # failure-detected -> ready-again latency, the recovery-time
+        # distribution the bench chaos row reports
+        self.recovery_ms = deque(maxlen=1000)
+
+    def summary(self) -> dict:
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        return {
+            "crashes": self.crashes,
+            "watchdog_trips": self.watchdog_trips,
+            "recoveries": self.recoveries,
+            "consecutive_failures": self.consecutive_failures,
+            "rejected_unready": self.rejected_unready,
+            "recovery_p50_ms": rnd(percentile(list(self.recovery_ms), 50)),
+            "recovery_p99_ms": rnd(percentile(list(self.recovery_ms), 99)),
         }

@@ -339,6 +339,10 @@ def api_batch_server(tmp_path, rng):
     t.start()
     yield server.server_address, state
     server.shutdown()
+    if state._scheduler is not None:
+        # a leaked supervisor keeps its loop thread stepping forever —
+        # later fault-injection tests would race it for armed faults
+        state._scheduler.close()
 
 
 def test_api_batch_completions_greedy_matches_singles(api_batch_server,
@@ -680,3 +684,261 @@ def test_cli_runs_f32_and_q80_weight_files(tmp_path, rng, capsys, wt):
                  "--temperature", "0"])
     out = capsys.readouterr().out
     assert "Generated tokens:    4" in out, wt
+
+
+# -- serving resilience at the HTTP layer (ISSUE 3) -------------------------
+
+
+def test_api_healthz_readyz_routes(api_server):
+    """Liveness and readiness on the legacy (scheduler-off) server:
+    /healthz is the process-up probe, /readyz the routing signal."""
+    host, port = api_server
+    for path, key, want in (("/healthz", "status", "ok"),
+                            ("/readyz", "status", "ready")):
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        assert resp.status == 200, path
+        assert json.loads(resp.read())[key] == want
+
+
+def test_api_readyz_scheduler_states(sched_api_server):
+    """/readyz with the supervisor: 'idle' before the first request builds
+    it, 'ready' with the supervisor state once live."""
+    (host, port), state = sched_api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/readyz")
+    body = json.loads(conn.getresponse().read())
+    assert body == {"status": "ready", "scheduler": "idle"}
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": "ab", "max_tokens": 2,
+                             "temperature": 0}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 200
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/readyz")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert json.loads(resp.read())["state"] == "ready"
+    # /stats now carries the resilience block too
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/stats")
+    s = json.loads(conn.getresponse().read())
+    assert s["state"] == "ready"
+    assert s["resilience"]["recoveries"] == 0
+
+
+def test_api_draining_rejects_posts_but_stays_alive(sched_api_server):
+    """Graceful drain: POSTs 503 with Retry-After, /readyz goes unready,
+    /healthz stays 200 (a liveness restart would cut the drain short)."""
+    (host, port), state = sched_api_server
+    state.draining = True
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "ab", "max_tokens": 2}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert resp.getheader("Retry-After") is not None
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert json.loads(resp.read())["status"] == "draining"
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["status"] == "draining"
+    finally:
+        state.draining = False
+
+
+def test_api_sse_midstream_error_frame(sched_api_server):
+    """ISSUE 3 satellite: an SSE client already streaming tokens when the
+    step loop crashes must receive a structured error event and a
+    terminated stream ([DONE]) — never a silent hang."""
+    from distributed_llama_tpu.runtime.faults import FAULTS
+
+    (host, port), state = sched_api_server
+    try:
+        # pace the step loop so the stream provably cannot COMPLETE before
+        # the crash is armed below (warm caches make bare steps sub-ms)
+        FAULTS.arm("slow_step", times=0, ms=25.0)
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        req = {"prompt": "abab", "max_tokens": 5000, "temperature": 0,
+               "stream": True}
+        conn.request("POST", "/v1/completions", json.dumps(req),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # read until the first token chunk arrives — the stream is LIVE
+        first = b""
+        while not first.strip():
+            first = resp.fp.readline()
+        FAULTS.arm("step_raise")  # the next scheduler step crashes
+        raw = first.decode() + resp.read().decode()
+        events = [line[len("data: "):] for line in raw.splitlines()
+                  if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"  # the stream TERMINATED cleanly
+        parsed = [json.loads(e) for e in events[:-1]]
+        errs = [p for p in parsed if "error" in p]
+        assert len(errs) == 1, raw[-500:]
+        assert errs[0]["error"]["code"] == "engine_error"
+        assert "injected step_raise" in errs[0]["error"]["message"]
+        finals = [p for p in parsed if p.get("choices")
+                  and p["choices"][0]["finish_reason"]]
+        assert finals and finals[-1]["choices"][0]["finish_reason"] == "error"
+        # the supervisor recovers and the server keeps serving
+        sup = state._scheduler
+        deadline = 30.0
+        import time as _time
+        t0 = _time.perf_counter()
+        while not sup.ready and _time.perf_counter() - t0 < deadline:
+            _time.sleep(0.05)
+        assert sup.ready, sup.state
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "ab", "max_tokens": 2,
+                                 "temperature": 0}),
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        assert sup.sup_stats.recoveries == 1
+    finally:
+        FAULTS.clear()
+
+
+@pytest.fixture
+def tight_queue_server(tmp_path, rng):
+    """serve_batch=1 + queue_depth=1: one running slot, one queue seat —
+    the third concurrent request must be REJECTED, not queued."""
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=1, serve_chunk=16, queue_depth=1)
+    from http.server import ThreadingHTTPServer
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address, state
+    server.shutdown()
+    if state._scheduler is not None:
+        state._scheduler.close()
+
+
+def test_api_queue_overflow_429_retry_after(tight_queue_server):
+    """ISSUE 3: queue overflow returns a fast 429 + Retry-After instead of
+    queueing unboundedly, and /readyz reports the saturated queue."""
+    import time as _time
+
+    from distributed_llama_tpu.runtime.faults import FAULTS
+
+    (host, port), state = tight_queue_server
+    results = {}
+
+    def client(key, n):
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        req = {"prompt": "abab", "max_tokens": n, "temperature": 0,
+               "stream": True}
+        conn.request("POST", "/v1/completions", json.dumps(req),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        results[key] = (resp.status, resp.read().decode())
+
+    try:
+        FAULTS.arm("slow_step", times=0, ms=60.0)  # hold the slot busy
+        a = threading.Thread(target=client, args=("a", 30), daemon=True)
+        a.start()
+        # wait until A occupies the slot
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 30.0:
+            sup = state._scheduler
+            if sup is not None and any(
+                    s.req is not None for s in sup._sched.slots):
+                break
+            _time.sleep(0.02)
+        b = threading.Thread(target=client, args=("b", 2), daemon=True)
+        b.start()  # takes the single queue seat
+        t0 = _time.perf_counter()
+        while len(state._scheduler._sched._queue) < 1:
+            assert _time.perf_counter() - t0 < 30.0, "B never queued"
+            _time.sleep(0.02)
+        # C: queue full -> fast 429 with Retry-After
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "ab", "max_tokens": 2,
+                                 "temperature": 0}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert int(resp.getheader("Retry-After")) >= 1
+        assert "queue full" in json.loads(resp.read())["error"]
+        # readiness = engine healthy AND queue under bound
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 503
+        FAULTS.clear()  # let A and B finish normally
+        a.join(timeout=240)
+        b.join(timeout=240)
+        assert not a.is_alive() and not b.is_alive()
+        assert results["a"][0] == 200 and results["b"][0] == 200
+        assert state._scheduler.stats.requests_rejected == 1
+    finally:
+        FAULTS.clear()
+
+
+def test_api_batch_bad_temperature_is_400(api_batch_server):
+    """A malformed request field on the batch endpoint is a deterministic
+    client error: 400, never a retryable 503 'engine failure'."""
+    (host, port), state = api_batch_server
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    req = {"prompts": ["ab"], "max_tokens": 2, "temperature": "hot"}
+    conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    assert resp.getheader("Retry-After") is None
+    assert "ValueError" in json.loads(resp.read())["error"]
+
+
+def test_api_batch_borrow_crash_triggers_recovery(api_batch_server):
+    """A crash inside the exclusive borrow (the whole-batch generation
+    itself) must reach the supervisor: recovery runs, the engine is
+    rebuilt, and the endpoint serves again."""
+    import time as _time
+
+    from distributed_llama_tpu.apps.api_server import (
+        _batch_completion_chunks)
+
+    (host, port), state = api_batch_server
+    sup = state.scheduler()
+
+    def boom(*a, **k):
+        raise RuntimeError("borrowed engine crashed")
+        yield  # pragma: no cover — generator shape
+
+    sup.engine.generate_batch_stream = boom
+    body = {"prompts": ["ab", "ba"], "max_tokens": 3, "temperature": 0}
+    with pytest.raises(RuntimeError, match="borrowed engine crashed"):
+        list(_batch_completion_chunks(state, dict(body)))
+    t0 = _time.perf_counter()
+    while not sup.ready and _time.perf_counter() - t0 < 30.0:
+        _time.sleep(0.05)
+    assert sup.ready, sup.state
+    assert sup.sup_stats.crashes == 1
+    assert sup.sup_stats.recoveries == 1
+    # the rebuilt engine serves the endpoint again, end to end
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/batch/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    out = json.loads(resp.read())
+    assert all(c["finish_reason"] in ("stop", "length")
+               for c in out["choices"])

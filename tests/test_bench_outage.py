@@ -116,6 +116,39 @@ def test_serve_row_emits_valid_json():
     json.dumps(s)  # the row round-trips as machine-readable JSON
 
 
+def test_chaos_row_emits_valid_json():
+    """BENCH_CHAOS=1 adds the fault-injection resilience row
+    (bench._chaos_row): the Poisson trace replayed through the supervised
+    scheduler with injected mid-trace crashes, reporting availability %,
+    recovered vs failed request counts, and recovery p50 latency — all as
+    one machine-readable JSON variant (matching the structured-error
+    contract every other bench failure path follows)."""
+    r = _run_bench({
+        "BENCH_CHAOS": "1",
+        "BENCH_CHAOS_REQUESTS": "4",
+        "BENCH_CHAOS_BATCH": "2",
+        "BENCH_CHAOS_CRASHES": "1",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    chaos = [v for v in row.get("variants", [])
+             if "chaos" in v["metric"]]
+    assert len(chaos) == 1, row
+    c = chaos[0]
+    assert c["unit"] == "%" and 0.0 <= c["value"] <= 100.0
+    assert c["requests"] == 4 and c["crashes_injected"] >= 1
+    assert c["recoveries"] >= 1
+    assert c["requests_failed_frames"] >= 1  # structured frames delivered
+    # every request resolved one way or the other — nothing hung
+    assert (c["ok_first_attempt"] + c["recovered_by_retry"]
+            + c["unrecovered"]) == 4
+    assert c["recovery_p50_ms"] is None or c["recovery_p50_ms"] >= 0
+    json.dumps(c)  # the row round-trips as machine-readable JSON
+
+
 @pytest.mark.slow  # full dryrun compile in a subprocess (~100 s)
 def test_dryrun_pins_cpu_before_any_jax_call():
     # dryrun_multichip must succeed with NO ambient cpu pin — the driver's
