@@ -36,7 +36,10 @@ the north-star shape on one chip), BENCH_TOKENS=<n decode steps>,
 BENCH_SEQ/BENCH_FILL for long-context variants, BENCH_CACHE=f8 for the fp8
 KV cache, BENCH_VARIANTS=0 to skip the extra rows, BENCH_SERVE=1 to add
 the continuous-batching Poisson-arrival serving row (_serve_row;
-BENCH_SERVE_REQUESTS/_BATCH/_BUDGETS size the trace).
+BENCH_SERVE_REQUESTS/_BATCH/_BUDGETS size the trace), BENCH_PREFIX=1 to
+add the radix prefix-cache shared-system-prompt row (_prefix_row;
+BENCH_PREFIX_REQUESTS/_BATCH/_SYS/_BLOCK/_TOKENS size it), BENCH_CHAOS=1
+to add the fault-injection resilience row (_chaos_row).
 """
 
 from __future__ import annotations
@@ -605,6 +608,120 @@ def _serve_row(params, spec: ModelSpec, prefix: str, b: int = 8) -> dict:
     }
 
 
+def _prefix_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
+    """Radix prefix cache under a shared-system-prompt workload (the
+    ISSUE-4 metric): replay a fixed-seed Poisson arrival trace whose
+    prompts share a common system prefix — the dominant production
+    chat/RAG shape — through the slot scheduler twice, cache OFF then
+    ON (runtime/prefix_cache.py), and report:
+
+      * prefill tokens served from cache (the headline %, acceptance
+        bar >= 50 on this workload),
+      * greedy TOKEN PARITY between the runs (seeded K/V is bitwise the
+        cold prefill's K/V, so outputs must be identical),
+      * TTFT p50 delta — the latency a returning client actually gains
+        when its system prompt + history seed instead of prefilling,
+      * the modeled wire/HBM tradeoff (netstats.estimate_prefix_reuse).
+
+    The FIRST request runs alone before the measured replay (cache ON
+    and OFF both, for symmetry): a shared system prompt is warm long
+    before any steady-state window, and publishing happens at
+    prefill-finish, so the replayed requests all see a warm tree.
+
+    Env knobs: BENCH_PREFIX_REQUESTS (default 16), BENCH_PREFIX_BATCH
+    (default 4), BENCH_PREFIX_SYS (shared prefix tokens, default 48),
+    BENCH_PREFIX_BLOCK (block_len, default 16 — the shared prefix is a
+    whole number of blocks so the whole-blocks-only lookup covers it),
+    BENCH_PREFIX_TOKENS (per-request decode budget, default 8)."""
+    import gc
+    import time
+
+    from distributed_llama_tpu.runtime.netstats import estimate_prefix_reuse
+    from distributed_llama_tpu.runtime.prefix_cache import PrefixCache
+    from distributed_llama_tpu.runtime.scheduler import Scheduler
+    from distributed_llama_tpu.sampler import Sampler
+
+    b = int(os.environ.get("BENCH_PREFIX_BATCH", str(b)))
+    n_req = max(int(os.environ.get("BENCH_PREFIX_REQUESTS", "16")), 2)
+    sys_len = int(os.environ.get("BENCH_PREFIX_SYS", "48"))
+    bl = int(os.environ.get("BENCH_PREFIX_BLOCK", "16"))
+    budget = int(os.environ.get("BENCH_PREFIX_TOKENS", "8"))
+    seq = min(512, spec.seq_len)
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, spec.vocab_size, sys_len).astype(
+        np.int64).tolist()
+    tails = [rng.integers(1, spec.vocab_size, (8, 12, 16)[i % 3]).astype(
+        np.int64).tolist() for i in range(n_req)]
+    prompts = [shared + t for t in tails]
+    arrivals = np.cumsum(rng.exponential(0.04, n_req - 1))
+
+    eng = Engine(spec, params, compute_dtype=cdt, cache_dtype=cdt,
+                 max_seq_len=seq, batch=b)
+
+    def greedy():
+        return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=7)
+
+    def run_trace(pc):
+        """One full serve of the trace; returns (per-request token lists,
+        replayed-requests TTFT p50 ms)."""
+        sched = Scheduler(eng, chunk=bl, prefix_cache=pc)
+        sched.warmup()  # compile keys (incl. seed/publish) off the clock
+        prime = sched.submit(prompts[0], budget, greedy())
+        while not prime.finished.is_set():
+            sched.step()
+        sched.start()
+        live = []
+        try:
+            t0 = time.perf_counter()
+            for arr, p in zip(arrivals, prompts[1:]):
+                dt = t0 + arr - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                live.append(sched.submit(p, budget, greedy()))
+            for r in live:
+                assert r.finished.wait(600), "scheduler stalled"
+        finally:
+            sched.close()
+        outs = [list(prime.tokens(timeout=5.0))]
+        outs += [list(r.tokens(timeout=5.0)) for r in live]
+        ttfts = sorted(r.stats.ttft_ms for r in live)
+        return outs, ttfts[len(ttfts) // 2]
+
+    outs_off, ttft_off = run_trace(None)
+    pc = PrefixCache(eng, num_blocks=max(2 * b * seq // bl,
+                                         sys_len // bl + 8), block_len=bl)
+    outs_on, ttft_on = run_trace(pc)
+
+    s = pc.stats.summary()
+    # hbm_copy uses the REAL copied volume: every hit gathers the full
+    # fixed seed width (seq // bl blocks), not just the matched tokens —
+    # the single-compilation-key tradeoff estimate_prefix_reuse documents
+    reuse = estimate_prefix_reuse(spec, eng.mesh,
+                                  tokens_saved=s["tokens_saved"],
+                                  tokens_copied=s["hits"] * (seq // bl) * bl,
+                                  cache_bytes=jnp.dtype(cdt).itemsize)
+    del eng
+    gc.collect()
+    return {
+        "metric": f"{prefix}_prefix_cache_block{bl}_prefill_saved_pct",
+        "value": round(100.0 * (s["prefill_saved_frac"] or 0.0), 2),
+        "unit": "%", "vs_baseline": None,
+        "requests": n_req, "batch": b,
+        "shared_prefix_tokens": sys_len, "block_len": bl,
+        "token_parity": outs_on == outs_off,
+        "hit_rate": s["hit_rate"],
+        "tokens_saved": s["tokens_saved"],
+        "blocks_published": s["blocks_published"],
+        "evictions": s["evictions"],
+        "ttft_p50_ms_off": round(ttft_off, 3),
+        "ttft_p50_ms_on": round(ttft_on, 3),
+        "ttft_p50_delta_ms": round(ttft_off - ttft_on, 3),
+        **reuse,
+    }
+
+
 def _chaos_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
     """Serving resilience under injected faults (the ISSUE-3 metric):
     replay a fixed-seed Poisson arrival trace through the SUPERVISED
@@ -959,6 +1076,13 @@ def main() -> None:
             # driver opts in with BENCH_SERVE=1 for the serving A/B
             emit(_serve_row(params, spec,
                             prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_PREFIX", "0") != "0":
+            # radix prefix-cache row (runtime/prefix_cache.py): the
+            # shared-system-prompt trace served cache OFF vs ON —
+            # prefill tokens saved %, TTFT delta, greedy token parity
+            emit(_prefix_row(params, spec,
+                             prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_CHAOS", "0") != "0":
             # resilience row (runtime/resilience.py): the Poisson trace

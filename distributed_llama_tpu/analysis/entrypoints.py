@@ -147,6 +147,33 @@ def entry_points(max_devices: int | None = None,
         (params_c, tok_c, pos_c, lidx_c, cache_c),
         {"activation_elems": 4 * 8 * spec_c.dim, "dim": spec_c.dim}))
 
+    # slot_seed_prefix: the radix prefix cache's admission-time seeding
+    # (runtime/prefix_cache.py) — an on-device arena-block gather written
+    # as a slot row's leading cache positions. Traced through the SAME
+    # module-level body the engine jits (engine.seed_rows_from_blocks),
+    # so the pinned fingerprint covers the real serving seed path: a
+    # drifting block_ids dtype or arity here would retrace per admission.
+    from ..runtime.engine import seed_rows_from_blocks
+
+    spec_x, _, _, _, cache_x = build_forward_inputs(batch=4, t=1)
+    bl_x = 8
+    mb_x = spec_x.seq_len // bl_x
+    arena_shape = (4, spec_x.n_layers, spec_x.n_kv_heads, bl_x,
+                   spec_x.head_size)
+    arena_k = jnp.zeros(arena_shape, jnp.float32)
+    arena_v = jnp.zeros(arena_shape, jnp.float32)
+    ids_x = jnp.zeros((mb_x,), jnp.int32)
+
+    def slot_seed_prefix(cache, arena_k, arena_v, row, block_ids):
+        return seed_rows_from_blocks(cache, arena_k, arena_v, row,
+                                     block_ids)
+
+    out.append(EntryPoint(
+        "slot_seed_prefix", slot_seed_prefix,
+        (cache_x, arena_k, arena_v, jnp.int32(0), ids_x),
+        {"activation_elems": mb_x * bl_x * spec_x.n_kv_heads
+         * spec_x.head_size, "dim": spec_x.dim}))
+
     if n_dev >= 2:
         from ..parallel import make_mesh
         from ..parallel.tp_q80 import tp_col_matmul, tp_row_matmul

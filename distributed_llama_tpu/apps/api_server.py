@@ -68,7 +68,9 @@ class ApiState:
     def __init__(self, engine, tokenizer, sampler, model_name: str = "dllama",
                  lookup_decode: int = 0, serve_batch: int = 0,
                  serve_chunk: int = 0, queue_depth: int = 0,
-                 request_deadline: float = 0.0, stall_timeout: float = 0.0):
+                 request_deadline: float = 0.0, stall_timeout: float = 0.0,
+                 prefix_cache: bool = False, prefix_blocks: int = 0,
+                 prefix_block_len: int = 32):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -95,6 +97,14 @@ class ApiState:
         # read per step (bench.py's continuous-batching row).
         self.serve_batch = serve_batch
         self.serve_chunk = serve_chunk  # prefill chunk; 0 = engine default
+        # radix prefix cache (runtime/prefix_cache.py): cross-request KV
+        # reuse on the scheduler path. blocks = 0 auto-sizes the arena to
+        # 2x the live cache footprint (2 * B * seq_len worth of blocks) —
+        # enough to keep several distinct system prompts + recent
+        # conversations resident without doubling engine memory twice
+        self.prefix_cache = prefix_cache
+        self.prefix_block_len = prefix_block_len
+        self.prefix_blocks = prefix_blocks
         # serializes legacy single-engine requests under the threaded
         # accept loop (the scheduler path needs no lock — it queues)
         self.engine_lock = threading.RLock()
@@ -128,11 +138,18 @@ class ApiState:
                         activation_q80=e.activation_q80,
                         prefill_chunk=e.prefill_chunk)
 
+                n_blocks = 0
+                if self.prefix_cache:
+                    bl = self.prefix_block_len
+                    n_blocks = self.prefix_blocks or max(
+                        2 * self.serve_batch * e.seq_len // bl, 1)
                 self._scheduler = EngineSupervisor(
                     engine_factory, chunk=self.serve_chunk or None,
                     max_queue=self.queue_depth or 4 * self.serve_batch,
                     request_deadline=self.request_deadline or None,
-                    stall_timeout=self.stall_timeout or 10.0)
+                    stall_timeout=self.stall_timeout or 10.0,
+                    prefix_blocks=n_blocks,
+                    prefix_block_len=self.prefix_block_len)
             return self._scheduler
 
     def batch_engine(self):
@@ -966,15 +983,46 @@ def serve(args) -> None:
             # prefix cache a --session file could describe
             sys.exit("error: --serve-batch (continuous-batching scheduler) "
                      "does not compose with --session prefix persistence")
+    if getattr(args, "prefix_cache", False) and not serve_batch:
+        # the radix cache lives on the slot scheduler (the legacy path
+        # keeps its own single-session prefix reuse) — loud error beats
+        # a silently ignored flag
+        sys.exit("error: --prefix-cache requires --serve-batch N "
+                 "(the radix cache serves the slot scheduler; the legacy "
+                 "path already reuses its single session's prefix)")
+    if not getattr(args, "prefix_cache", False) and (
+            getattr(args, "prefix_blocks", 0) > 0
+            or getattr(args, "prefix_block_len", None) is not None):
+        # same principle one flag over: sizing knobs without the cache
+        # itself would be silently dead configuration (block-len uses a
+        # None sentinel, so an EXPLICIT value — even the default 32 —
+        # is caught, and changing the default cannot break this check)
+        sys.exit("error: --prefix-blocks/--prefix-block-len have no "
+                 "effect without --prefix-cache")
 
     engine, tokenizer, sampler = build_engine(args)
+    prefix_block_len = getattr(args, "prefix_block_len", None) or 32
+    if getattr(args, "prefix_cache", False):
+        # validate the arena config against the REAL engine context at
+        # startup — the supervisor builds lazily on the first request,
+        # and a bad block length must be a CLI error, not a 500 every
+        # request (PrefixCache.__init__ would assert there)
+        bl = prefix_block_len
+        if not 1 <= bl <= engine.seq_len:
+            sys.exit(f"error: --prefix-block-len {bl} outside 1.."
+                     f"{engine.seq_len} (the engine context)")
+        if getattr(args, "prefix_blocks", 0) < 0:
+            sys.exit("error: --prefix-blocks must be >= 0 (0 = auto)")
     state = ApiState(engine, tokenizer, sampler,
                      lookup_decode=getattr(args, "lookup_decode", 0),
                      serve_batch=serve_batch,
                      serve_chunk=getattr(args, "serve_chunk", 0),
                      queue_depth=getattr(args, "queue_depth", 0),
                      request_deadline=getattr(args, "request_deadline", 0.0),
-                     stall_timeout=getattr(args, "stall_timeout", 0.0))
+                     stall_timeout=getattr(args, "stall_timeout", 0.0),
+                     prefix_cache=getattr(args, "prefix_cache", False),
+                     prefix_blocks=getattr(args, "prefix_blocks", 0),
+                     prefix_block_len=prefix_block_len)
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "

@@ -142,6 +142,32 @@ def build_argparser() -> argparse.ArgumentParser:
                         "Smaller C bounds the inter-token stall admission "
                         "adds to running requests; larger C prefills new "
                         "prompts in fewer steps (docs/serving.md)")
+    # prefix-cache flags (api mode; runtime/prefix_cache.py,
+    # docs/serving.md "Prefix caching")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="api mode, with --serve-batch: radix prefix cache "
+                        "— cross-request KV reuse (runtime/prefix_cache"
+                        ".py). Admissions seed the longest cached token "
+                        "prefix (shared system prompts, few-shot "
+                        "templates, chat history) from an on-device "
+                        "block arena and prefill only the suffix; "
+                        "finished prompts publish their blocks back. "
+                        "GET /stats gains a prefix_cache hit-rate/"
+                        "tokens-saved block. Net-new: the reference "
+                        "recomputes every prompt from scratch")
+    p.add_argument("--prefix-blocks", type=int, default=0, metavar="N",
+                   help="prefix-cache arena size in blocks (0 = auto: "
+                        "2 x serve-batch x context worth of blocks). "
+                        "Arena bytes = N x 2 x layers x kv_heads x "
+                        "block_len x head_size x cache dtype — budget it "
+                        "against the B-row KV cache (docs/serving.md)")
+    p.add_argument("--prefix-block-len", type=int, default=None,
+                   metavar="L",
+                   help="prefix-cache block granularity in tokens "
+                        "(default 32): reuse is whole-blocks-only, so "
+                        "smaller L matches more of a shared prefix but "
+                        "spends more index/publish work per token "
+                        "(docs/serving.md)")
     # serving-resilience flags (api mode; runtime/resilience.py,
     # docs/operations.md)
     p.add_argument("--queue-depth", type=int, default=0, metavar="N",

@@ -67,8 +67,9 @@ def _f8_bits_to(u8, out_dtype):
     also unsupported, so the reassembly stays in 32-bit lanes: a normal
     number's f32 bits are sign<<31 | (exp+120)<<23 | mant<<20; subnormals
     (mag < 8) take an int->float ladder (value = mant * 2^-9, exact in
-    3 mantissa bits). Writes saturate (models/transformer._to_cache_dtype),
-    so NaN/inf bit patterns never occur in the cache."""
+    3 mantissa bits). Writes saturate (models/transformer._to_cache_dtype)
+    and seeding boundaries sanitize (saturate_f8_nan_codes below), so
+    NaN/inf bit patterns never occur in the cache."""
     i = u8.astype(jnp.int32)
     sign = (i & 0x80) << 24
     mag = i & 0x7F
@@ -78,6 +79,32 @@ def _f8_bits_to(u8, out_dtype):
                      normal) | sign
     f = jax.lax.bitcast_convert_type(bits, jnp.float32)
     return f if out_dtype == jnp.float32 else f.astype(out_dtype)
+
+
+def saturate_f8_nan_codes(x):
+    """Map e4m3fn NaN bit patterns (magnitude 0x7F) to the saturated max
+    (+-448) so they can never reach ``_f8_bits_to``, which decodes the
+    0x7F magnitude as a finite 480.0 (ADVICE r5).
+
+    The kernel's correctness rests on the invariant that every cache
+    producer saturates (models/transformer._to_cache_dtype) — true for
+    all in-engine writes, but NOT enforceable for bytes that arrive from
+    OUTSIDE a forward: a checkpoint-restored session file
+    (Engine.load_session) or a prefix-cache arena seed
+    (Engine.slot_seed_prefix) could carry 0x7F from a buggy or foreign
+    producer, and one such byte at position p poisons every later
+    attention read past p. This is the guard every cache-SEEDING
+    boundary applies (Engine._seed_guard); non-f8 inputs pass through
+    untouched. Saturating (rather than asserting) keeps the seeding
+    paths jittable — a device-side assert would be a host callback in
+    the serving hot path."""
+    if x.dtype != F8_DTYPE:
+        return x
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint8)
+    mag = bits & jnp.uint8(0x7F)
+    fixed = jnp.where(mag == jnp.uint8(0x7F),
+                      (bits & jnp.uint8(0x80)) | jnp.uint8(0x7E), bits)
+    return jax.lax.bitcast_convert_type(fixed.astype(jnp.uint8), F8_DTYPE)
 # cap on T*G query rows per head panel: bounds the (rows, SB) f32 score tile
 # in VMEM (1024x512x4 = 2 MB; acc another 512 KB). Prefill chunks above it
 # fall back to the dense path — the engine's default chunk (256) stays under

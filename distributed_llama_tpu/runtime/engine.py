@@ -39,6 +39,49 @@ class GenerationResult(NamedTuple):
     stats: RunStats
 
 
+def seed_rows_from_blocks(cache: KVCache, arena_k, arena_v, row, block_ids
+                          ) -> KVCache:
+    """Seed cache row ``row``'s leading positions from prefix-arena blocks
+    — the traced body of ``Engine.slot_seed_prefix`` (module-level so
+    analysis/entrypoints.py fingerprints the SAME program the engine jits;
+    dlgrind's DLG204 gate covers the serving seed path by construction).
+
+    arena_k/arena_v: (num_blocks, layers, kv_heads, block_len, head_size)
+    — block-major with the per-layer block laid out exactly like a cache
+    slice (KVH before the sequence dim), so seed and publish are pure
+    gathers/reshapes, never transposed HBM traffic against the cache's
+    head-major layout. block_ids is the FIXED-width
+    (seq_len // block_len,) int32 vector the scheduler always pads (with
+    block 0) — the pad keeps ONE compilation key for every admission
+    (the same discipline as slot_prefill_chunk's fixed C). Padded
+    blocks' writes land beyond the real seeded prefix and are
+    overwritten position-by-position (suffix prefill, then decode)
+    before any query can attend them — the same invariant decode
+    overruns rely on everywhere in the engine.
+
+    Blocks pass through the f8 NaN-code guard
+    (ops/pallas_attention.saturate_f8_nan_codes): arena bytes written by
+    this engine's own forwards are saturated already, but the seeding
+    boundary must not TRUST its producer — see Engine._seed_guard."""
+    from ..ops.pallas_attention import saturate_f8_nan_codes
+
+    mb = block_ids.shape[0]
+    _, _, kvh, bl, hs = arena_k.shape
+    z = jnp.int32(0)
+    row = jnp.asarray(row, jnp.int32)
+    k_all, v_all = [], []
+    for l in range(len(cache.k)):
+        new = []
+        for arena, leaf in ((arena_k, cache.k[l]), (arena_v, cache.v[l])):
+            seg = arena[block_ids, l]                  # (MB, KVH, bl, hs)
+            seg = seg.transpose(1, 0, 2, 3).reshape(1, kvh, mb * bl, hs)
+            seg = saturate_f8_nan_codes(seg.astype(leaf.dtype))
+            new.append(lax.dynamic_update_slice(leaf, seg, (row, z, z, z)))
+        k_all.append(new[0])
+        v_all.append(new[1])
+    return KVCache(tuple(k_all), tuple(v_all))
+
+
 class Engine:
     def __init__(
         self,
@@ -325,22 +368,15 @@ class Engine:
         assert pos <= self.seq_len
         self.reset()
         dt = jnp.dtype(self.cache_dtype)
-        # build each restored row ON DEVICE (fresh zeros + scatter of the
-        # saved prefix) so the buffer is XLA-owned. Wholesale
-        # device_put/asarray of a host temporary here produced buffers
-        # whose DONATION into the first jitted step intermittently yielded
-        # NaN-poisoned garbage on the CPU backend (the
-        # test_api_session_survives_restart flake — use-after-free of the
-        # host staging memory); a computed output can never alias host
-        # memory, so donating it is safe. out_shardings materializes the
-        # full-seq_len result straight into the sharded layout — no
-        # device ever holds a whole unsharded row (only the transient
-        # prefix input is replicated).
+        # cache rows are built ON DEVICE through the shared seeding
+        # helper (_seed_jit / _seed_guard — one home for the
+        # donation-safety fix and the f8 NaN-code guard)
         shape = (self.batch, self.spec.n_kv_heads, self.seq_len,
                  self.spec.head_size)
-        build = jax.jit(
-            lambda pfx: jnp.zeros(shape, dt).at[:, :, :pos, :].set(pfx),
-            out_shardings=self._cache_sharding)
+        build = self._seed_jit(
+            lambda pfx: jnp.zeros(shape, dt).at[:, :, :pos, :].set(
+                self._seed_guard(pfx)),
+            out_tree=0)
         k_all, v_all = [], []
         for l in range(self.spec.n_layers):
             k_all.append(build(z[f"k{l}"].view(dt)))
@@ -348,6 +384,45 @@ class Engine:
         self.cache = KVCache(tuple(k_all), tuple(v_all))
         self.pos = pos
         return z["tokens"].tolist() if "tokens" in z.files else []
+
+    # -- cache seeding (session restore + prefix-cache arena) -------------
+
+    def _seed_guard(self, x):
+        """Sanitize bytes entering the cache from OUTSIDE a forward (the
+        cache-SEEDING boundary: load_session's npz prefix, the prefix
+        arena's blocks). In-engine writes saturate
+        (models/transformer._to_cache_dtype), so the flash kernel's
+        _f8_bits_to never sees an e4m3 NaN code — but a session file or
+        arena did not necessarily come from a saturating producer, and
+        one 0x7F byte would decode as a finite 480.0 and poison every
+        later attention read (ADVICE r5). Non-f8 dtypes pass through."""
+        from ..ops.pallas_attention import saturate_f8_nan_codes
+
+        return saturate_f8_nan_codes(x)
+
+    def _seed_jit(self, fn, *, out_tree, donate: tuple = ()):
+        """The ONE jit wrapper for every path that builds cache rows on
+        device (Engine.load_session, Engine.slot_seed_prefix) — the
+        single home of the PR 3 donation-safety fix:
+
+          * the result is COMPUTED on device (fresh zeros + scatter, or a
+            gather from the arena), never a device_put/asarray of a host
+            temporary — a computed output cannot alias host staging
+            memory, so donating it into the first jitted step is safe
+            (wholesale device_put here produced intermittent NaN-poisoned
+            logits: use-after-free of the host buffer after donation);
+          * out_shardings pins every cache output to the engine's cache
+            layout, so sharded meshes materialize the full-seq_len result
+            straight into the sharded placement — no device ever holds a
+            whole unsharded row (only transient prefix inputs replicate).
+
+        `out_tree` is any pytree matching the output structure (its leaf
+        values are ignored — one cache sharding per leaf)."""
+        if self._cache_sharding is None:
+            return jax.jit(fn, donate_argnums=donate)
+        shardings = jax.tree_util.tree_map(lambda _: self._cache_sharding,
+                                           out_tree)
+        return jax.jit(fn, donate_argnums=donate, out_shardings=shardings)
 
     def _session_fingerprint(self) -> list[int]:
         # architecture dims + cache shape/dtype + the WEIGHT CONTENT hash:
@@ -1018,6 +1093,83 @@ class Engine:
         logits, self.cache = self._steps[key](self.params, tok, posv,
                                               self.cache)
         return logits
+
+    # -- prefix-cache arena steps (runtime/prefix_cache.py) ---------------
+
+    def new_prefix_arena(self, num_blocks: int, block_len: int):
+        """Allocate the radix prefix cache's block arena: K and V arrays
+        of (num_blocks, layers, kv_heads, block_len, head_size) in the
+        cache dtype. Computed on device (jitted zeros — donation-safe by
+        the _seed_jit discipline, though the arena itself is NEVER
+        donated into a forward: blocks are immutable once published and
+        shared across requests). The arena dies with the engine — a
+        supervisor rebuild mints a fresh engine, a fresh arena, and an
+        empty tree (runtime/resilience.EngineSupervisor._make_sched)."""
+        assert self._pp == 1, "prefix cache does not support --pp"
+        assert num_blocks >= 1 and 1 <= block_len <= self.seq_len
+        shape = (num_blocks, self.spec.n_layers, self.spec.n_kv_heads,
+                 block_len, self.spec.head_size)
+        dt = self.cache_dtype
+        key = ("prefix_arena", shape)
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                lambda: (jnp.zeros(shape, dt), jnp.zeros(shape, dt)))
+        return self._steps[key]()
+
+    def slot_seed_prefix(self, arena_k, arena_v, row: int,
+                         block_ids: np.ndarray) -> None:
+        """Seed slot row `row`'s leading cache positions from arena
+        blocks (on-device block-gather -> cache row write; the cache is
+        donated and updated in place). `block_ids` is the fixed-width
+        (seq_len // block_len,) vector — the scheduler pads it with
+        block 0, so this is ONE compilation key total ("slot_seed"),
+        fingerprinted in analysis/baseline.json like the other two
+        serving executables. See seed_rows_from_blocks for the padding
+        invariant and the f8 seeding guard; _seed_jit for the
+        donation-safety/out_shardings discipline. Does not touch
+        self.pos (per-slot positions are the scheduler's)."""
+        mb, bl = block_ids.shape[0], arena_k.shape[3]
+        key = ("slot_seed", mb, bl)
+        if key not in self._steps:
+            run = seed_rows_from_blocks
+            self._steps[key] = self._seed_jit(run, out_tree=self.cache,
+                                              donate=(0,))
+        self.cache = self._steps[key](
+            self.cache, arena_k, arena_v, jnp.int32(row),
+            jnp.asarray(block_ids, jnp.int32))
+
+    def slot_publish_block(self, arena_k, arena_v, row: int, offset: int,
+                           dst: int):
+        """Copy slot row `row`'s filled cache positions
+        [offset, offset + block_len) into arena block `dst` and return
+        the updated (arena_k, arena_v). The arenas are donated (in-place
+        block write); the cache is only read. One compilation key total
+        (row/offset/dst are traced scalars), so publishing never mints
+        executables however requests finish. The copied bytes came from
+        this engine's own saturating cache writes — the NaN-code guard
+        runs on the SEED side, where the producer cannot be trusted."""
+        bl = arena_k.shape[3]
+        kvh, hs = self.spec.n_kv_heads, self.spec.head_size
+        n_l = self.spec.n_layers
+        key = ("slot_publish", bl)
+        if key not in self._steps:
+            def run(arena_k, arena_v, cache, row, off, dst):
+                z = jnp.int32(0)
+                outs = []
+                for arena, leaves in ((arena_k, cache.k), (arena_v, cache.v)):
+                    blk = jnp.stack([
+                        lax.dynamic_slice(leaves[l], (row, z, off, z),
+                                          (1, kvh, bl, hs))[0]
+                        for l in range(n_l)])       # (L, KVH, bl, hs)
+                    outs.append(lax.dynamic_update_slice(
+                        arena, blk[None], (dst, z, z, z, z)))
+                return tuple(outs)
+
+            run.__name__ = "slot_publish_block"
+            self._steps[key] = jax.jit(run, donate_argnums=(0, 1))
+        return self._steps[key](arena_k, arena_v, self.cache,
+                                jnp.int32(row), jnp.int32(offset),
+                                jnp.int32(dst))
 
     # -- batched speculative (prompt-lookup) greedy generation ------------
 

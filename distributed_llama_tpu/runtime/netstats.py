@@ -138,6 +138,58 @@ def estimate_serve_wire(
                         {k: v / occ for k, v in step.breakdown.items()})
 
 
+def estimate_prefix_reuse(
+    spec: ModelSpec,
+    mesh,
+    *,
+    tokens_saved: int,
+    tokens_copied: int | None = None,
+    cache_bytes: float = 2.0,
+    q80: bool = False,
+    act_bytes: int = 4,
+    batch: int = 1,
+) -> dict:
+    """Modeled cost/benefit of serving `tokens_saved` prompt tokens from
+    the radix prefix cache (runtime/prefix_cache.py) instead of
+    prefilling them.
+
+    A seeded token SKIPS its prefill forward entirely, so it saves the
+    full per-token collective payload of a forward — the same per-layer
+    reduces estimate_decode_wire models (prefill segments move the same
+    per-token bytes as decode; only the segment width batches them).
+    What it pays instead is a pure-HBM block copy that rides NO
+    collective: 2 (K and V) * layers * kv_heads * head_size *
+    cache_bytes per token COPIED — and `tokens_copied` is NOT
+    `tokens_saved`: Engine.slot_seed_prefix always gathers the FULL
+    fixed seed width (seq_len // block_len blocks, the price of keeping
+    ONE compilation key), so every hit copies ~seq_len tokens' worth of
+    K/V however short the match. Callers must pass the real figure
+    (hits * (seq_len // block_len) * block_len); it defaults to
+    tokens_saved only as the lower bound. This is why a deep context
+    with tiny matches can pay more HBM than it saves — and why the
+    bench row reports both numbers side by side.
+
+    The wire side is why prefix reuse is still a near-strict win on
+    meshes: the copy rides no collective, HBM bandwidth is orders of
+    magnitude above ICI for the same bytes, and on a single chip the
+    copy replaces whole forwards' weight reads + FLOPs.
+
+    Returns {"wire_saved_kb", "hbm_copy_kb", "kb_saved_per_token"} —
+    the bench's BENCH_PREFIX row reports these next to the measured
+    TTFT delta."""
+    per_tok_kb = estimate_decode_wire(spec, mesh, q80=q80,
+                                      act_bytes=act_bytes,
+                                      batch=batch).sent_kb_per_token
+    copy_b = (2 * spec.n_layers * spec.n_kv_heads * spec.head_size
+              * cache_bytes)
+    copied = tokens_saved if tokens_copied is None else tokens_copied
+    return {
+        "wire_saved_kb": round(per_tok_kb * tokens_saved, 3),
+        "hbm_copy_kb": round(copy_b * copied / 1024.0, 3),
+        "kb_saved_per_token": round(per_tok_kb, 4),
+    }
+
+
 COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                       "all-to-all", "collective-permute")
 

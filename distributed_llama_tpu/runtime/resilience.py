@@ -82,9 +82,18 @@ class EngineSupervisor:
                  request_deadline: float | None = None,
                  stall_timeout: float = 10.0, watchdog_poll: float = 0.02,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
-                 breaker_threshold: int = 3):
+                 breaker_threshold: int = 3,
+                 prefix_blocks: int = 0, prefix_block_len: int = 32):
         self._factory = engine_factory
         self._chunk = chunk
+        # prefix_blocks > 0 attaches a radix prefix cache
+        # (runtime/prefix_cache.py) to every generation's scheduler. The
+        # cache is minted FRESH in _make_sched: its block arena holds
+        # K/V only the generation's own engine wrote, so a rebuild
+        # invalidates the whole tree by construction (plus the explicit
+        # Scheduler._abort_all invalidate on the dying generation).
+        self._prefix_blocks = int(prefix_blocks)
+        self._prefix_block_len = int(prefix_block_len)
         self.max_queue = int(max_queue)
         self._queue_timeout = queue_timeout
         self._request_deadline = request_deadline
@@ -129,6 +138,12 @@ class EngineSupervisor:
         """The CURRENT generation's ServeStats (windows/percentiles);
         cross-generation totals live in summary()."""
         return self._sched.stats
+
+    @property
+    def prefix_cache(self):
+        """The CURRENT generation's radix prefix cache (None when off) —
+        like `stats`, this swaps wholesale on recovery."""
+        return self._sched.prefix_cache
 
     @property
     def state(self) -> str:
@@ -256,10 +271,17 @@ class EngineSupervisor:
     # -- internals ---------------------------------------------------------
 
     def _make_sched(self, engine) -> Scheduler:
+        pc = None
+        if self._prefix_blocks > 0:
+            from .prefix_cache import PrefixCache
+
+            pc = PrefixCache(engine, num_blocks=self._prefix_blocks,
+                             block_len=self._prefix_block_len)
         return Scheduler(engine, chunk=self._chunk,
                          max_queue=self.max_queue,
                          queue_timeout=self._queue_timeout,
-                         request_deadline=self._request_deadline)
+                         request_deadline=self._request_deadline,
+                         prefix_cache=pc)
 
     def _start_loop(self, sched: Scheduler, gen: int) -> None:
         for g in [g for g, t in self._loop_threads.items()

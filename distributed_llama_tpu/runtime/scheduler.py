@@ -33,6 +33,18 @@ yield EXACTLY the tokens of a sequential ``Engine.generate`` run
 (tests/test_scheduler.py pins token-identical parity, including mid-decode
 joins and early-finish slot handoffs).
 
+Cross-request KV reuse: with a ``runtime/prefix_cache.PrefixCache``
+attached, ``_admit`` looks up the longest cached token prefix, seeds the
+slot's cache rows from arena blocks (``Engine.slot_seed_prefix``) and
+prefills only the uncached suffix; a slot publishes its PROMPT's K/V
+back into the radix tree when the prompt finishes prefilling (prefill-
+written blocks only — decode-step K/V is not guaranteed bitwise-equal
+to a cold prefill's, and publishing it would void the exact-parity
+guarantee). The matched path stays PINNED for the slot's lifetime so
+eviction can never free a block an in-flight slot came from, and the
+whole tree is invalidated whenever the engine generation dies
+(``_abort_all`` — the arena dies with the engine).
+
 Thread model: ``submit()`` is thread-safe; the step loop runs either on
 the ``start()`` background thread or synchronously via ``step()`` (tests,
 the bench). ``exclusive()`` drains all in-flight work and lends the
@@ -162,9 +174,11 @@ class ServeRequest:
 class _Slot:
     """One row of the batched KV cache. state is derived: FREE when req is
     None, PREFILL while off < len(prompt), DECODE after. `pos` is the next
-    cache write position, `last` the token to feed next step."""
+    cache write position, `last` the token to feed next step. `pins` is
+    the prefix-cache path the slot was seeded from (held until the slot
+    releases so eviction can't free its source blocks)."""
 
-    __slots__ = ("idx", "req", "pos", "off", "n_out", "last")
+    __slots__ = ("idx", "req", "pos", "off", "n_out", "last", "pins")
 
     def __init__(self, idx: int):
         self.idx = idx
@@ -173,16 +187,24 @@ class _Slot:
         self.off = 0
         self.n_out = 0
         self.last = 0
+        self.pins: tuple = ()
 
 
 class Scheduler:
     def __init__(self, engine, *, chunk: int | None = None,
                  max_queue: int = 0, queue_timeout: float | None = None,
-                 request_deadline: float | None = None):
+                 request_deadline: float | None = None,
+                 prefix_cache=None):
         self.engine = engine
         self.chunk = int(chunk or min(engine.prefill_chunk, engine.seq_len))
         assert 1 <= self.chunk <= engine.seq_len, self.chunk
         self.slots = [_Slot(i) for i in range(engine.batch)]
+        # radix prefix cache (runtime/prefix_cache.PrefixCache) — must be
+        # built over THIS engine's arena; a supervisor rebuild passes a
+        # fresh one (the arena dies with the engine). None = reuse off.
+        self.prefix_cache = prefix_cache
+        assert prefix_cache is None or prefix_cache.engine is engine, (
+            "prefix cache arena belongs to a different engine")
         # admission control: max_queue bounds the waiting line (0 = no
         # bound — the supervisor/API layer sets one); queue_timeout bounds
         # how long a request may WAIT before it must be failed rather than
@@ -200,6 +222,8 @@ class Scheduler:
         self._mutex = threading.RLock()  # step()/exclusive() mutual excl.
         self._wake = threading.Event()
         self.stats = ServeStats()
+        if prefix_cache is not None:
+            self.stats.prefix = prefix_cache.stats
         self._thread: threading.Thread | None = None
         self._stop = False
         self._closed = False
@@ -315,6 +339,7 @@ class Scheduler:
                 self._finish_slot(s, "cancelled")
             elif s.req.expired(now):
                 req, s.req = s.req, None
+                self._release_slot_cache(s, req)
                 self._expire_req(req)
         self._admit()
         pre = [s for s in self.slots
@@ -367,10 +392,25 @@ class Scheduler:
             s.pos = 0
             s.n_out = 0
             s.last = 0
+            s.pins = ()
             # slot "reset" is host-side bookkeeping ONLY — no cache zeroing
             # or reallocation. The new request's prefill/decode overwrites
             # every position before any of its queries can attend it, so
             # the predecessor's stale K/V is unreachable by construction.
+            if self.prefix_cache is not None:
+                # cross-request KV reuse: seed the longest cached prefix
+                # (whole blocks, capped at len - 1 so the finishing chunk
+                # still samples real logits) and prefill only the suffix.
+                # The matched path stays pinned until the slot releases.
+                n, ids, pins = self.prefix_cache.lookup_pin(req.prompt)
+                if n > 0:
+                    self.prefix_cache.seed_slot(s.idx, ids)
+                    s.off = n
+                    s.pins = pins
+                # (tokens_prefilled is counted per dispatched chunk in
+                # _prefill_chunk — counting the whole suffix here would
+                # overstate the denominator for requests cancelled or
+                # expired mid-prefill)
 
     def _prefill_chunk(self, rows: list[_Slot]) -> None:
         eng = self.engine
@@ -382,6 +422,10 @@ class Scheduler:
         for s in rows:
             n = min(c, len(s.req.prompt) - s.off)
             tok[s.idx, :n] = s.req.prompt[s.off:s.off + n]
+            if self.prefix_cache is not None:
+                # real (non-pad) tokens this forward actually prefills —
+                # the honest denominator for prefill_saved_frac
+                self.prefix_cache.stats.tokens_prefilled += n
             # tail padding (token 0) writes land beyond the prompt and are
             # overwritten by decode before any later query attends them
             pos[s.idx] = s.off
@@ -395,6 +439,14 @@ class Scheduler:
         lg = eng.fetch_logits(logits)
         for s in finishing:
             s.pos = len(s.req.prompt)
+            if self.prefix_cache is not None:
+                # publish the prompt's blocks the moment they are all
+                # written — NOT at slot finish — so concurrent requests
+                # sharing the prefix hit while this one still decodes
+                # (blocks are immutable once published; a re-publish of
+                # already-indexed blocks walks the tree and copies
+                # nothing)
+                self.prefix_cache.publish(s.idx, s.req.prompt)
             if s.req.max_tokens <= 0:
                 # hard-cap contract, same as Engine.generate: the prefill
                 # ran, nothing is emitted
@@ -440,8 +492,34 @@ class Scheduler:
         elif s.n_out >= req.max_tokens or s.pos >= self.engine.seq_len:
             self._finish_slot(s, "length")
 
+    def _release_slot_cache(self, s: _Slot, req: ServeRequest) -> None:
+        """Prefix-cache bookkeeping for a slot leaving any path: release
+        the seed pins, and for a slot retiring MID-PREFILL (cancel,
+        deadline) publish the prompt prefix it did write (s.off only
+        advances after a chunk's forward ran, so [0, off) is always real
+        data). Completed prompts published at prefill-finish already.
+
+        Only PREFILL-written blocks are ever published — never the
+        decode extension (req.prompt + fed tokens): decode-step K/V is
+        not guaranteed bitwise-equal to what a cold prefill of the same
+        tokens would write (different executables may reduce in a
+        different order under bf16), and seeding it would silently void
+        the cache-on == cache-off token-parity guarantee. Multi-turn
+        reuse barely loses: turn N+1's prompt embeds turn N's reply,
+        hits turn N's PROMPT blocks, re-prefills just the reply + new
+        message — and its own prefill-finish publish then covers the
+        full turn-N+1 prompt for turn N+2. This also bounds publish
+        work to once per prompt, not per retirement."""
+        if self.prefix_cache is None:
+            return
+        if 0 < s.off < len(req.prompt):
+            self.prefix_cache.publish(s.idx, req.prompt[: s.off])
+        self.prefix_cache.unpin(s.pins)
+        s.pins = ()
+
     def _finish_slot(self, s: _Slot, reason: str) -> None:
         req, s.req = s.req, None  # slot is FREE from here on
+        self._release_slot_cache(s, req)
         self._finish_req(req, reason)
 
     def _finish_req(self, req: ServeRequest, reason: str) -> None:
@@ -469,6 +547,17 @@ class Scheduler:
             eng.slot_prefill_chunk(np.zeros((eng.batch, self.chunk), np.int32),
                                    gate, np.zeros((eng.batch,), np.int32))
             eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32), gate)
+            if self.prefix_cache is not None:
+                # the seed/publish executables compile here too — a
+                # rebuilt engine's first prefix-cache admission must not
+                # read as a stall either. Unlike the gated forwards
+                # above, the seed warmup REALLY writes row 0, so the
+                # prose precondition (idle scheduler) is enforced: a
+                # warmup over a live slot 0 would replace its prefix K/V
+                # with arena bytes and silently corrupt its output
+                assert all(s.req is None for s in self.slots), (
+                    "prefix-cache warmup requires an idle scheduler")
+                self.prefix_cache.warmup()
 
     # -- background thread -------------------------------------------------
 
@@ -519,7 +608,15 @@ class Scheduler:
         hand-off here races only against that dead/stuck thread, whose
         scheduler generation is already discarded."""
         frame = {"code": code, "message": msg, "retryable": retryable}
+        if self.prefix_cache is not None:
+            # the engine generation behind the arena is being discarded
+            # (crash recovery, close) — recovered engines must never
+            # seed from a dead engine's blocks, so the WHOLE tree goes
+            # (a mere step exception on the legacy unsupervised loop
+            # also lands here: conservative cache loss, never staleness)
+            self.prefix_cache.invalidate()
         for s in self.slots:
+            s.pins = ()  # pinned nodes were detached by the invalidate
             if s.req is not None:
                 req, s.req = s.req, None
                 self._fail_req(req, frame)

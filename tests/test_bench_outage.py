@@ -116,6 +116,37 @@ def test_serve_row_emits_valid_json():
     json.dumps(s)  # the row round-trips as machine-readable JSON
 
 
+def test_prefix_row_emits_valid_json():
+    """BENCH_PREFIX=1 adds the radix prefix-cache row (bench._prefix_row):
+    the shared-system-prompt Poisson trace served cache OFF vs ON. The
+    acceptance bar rides the assertions: >= 50% of prefill tokens served
+    from cache on this workload, and greedy outputs TOKEN-IDENTICAL to
+    the cache-off run — all as one machine-readable JSON variant."""
+    r = _run_bench({
+        "BENCH_PREFIX": "1",
+        "BENCH_PREFIX_REQUESTS": "4",
+        "BENCH_PREFIX_BATCH": "2",
+        "BENCH_PREFIX_SYS": "48",
+        "BENCH_PREFIX_BLOCK": "16",
+        "BENCH_PREFIX_TOKENS": "6",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    pfx = [v for v in row.get("variants", [])
+           if "prefix_cache" in v["metric"]]
+    assert len(pfx) == 1, row
+    p = pfx[0]
+    assert p["unit"] == "%" and p["value"] >= 50.0  # acceptance bar
+    assert p["token_parity"] is True                # exact greedy parity
+    assert p["requests"] == 4 and p["hit_rate"] > 0
+    assert p["tokens_saved"] >= 48 * 3  # every replayed request seeded
+    assert p["ttft_p50_ms_on"] >= 0 and p["ttft_p50_ms_off"] >= 0
+    json.dumps(p)  # the row round-trips as machine-readable JSON
+
+
 def test_chaos_row_emits_valid_json():
     """BENCH_CHAOS=1 adds the fault-injection resilience row
     (bench._chaos_row): the Poisson trace replayed through the supervised

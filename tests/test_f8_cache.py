@@ -110,6 +110,60 @@ def test_f8_cache_flash_kernel_interpret():
                                rtol=0, atol=2e-2)
 
 
+def test_f8_seed_guard_saturates_nan_codes():
+    """saturate_f8_nan_codes (the cache-SEEDING boundary guard, ADVICE
+    r5): e4m3 NaN bit patterns (0x7F/0xFF) map to the saturated max
+    (+-448) — _f8_bits_to would otherwise decode them as a finite 480.0
+    — and every other code passes through bit-identically."""
+    from distributed_llama_tpu.ops.pallas_attention import (
+        saturate_f8_nan_codes)
+
+    codes = jnp.arange(256, dtype=jnp.uint8)
+    f8 = jax.lax.bitcast_convert_type(codes, jnp.float8_e4m3fn)
+    out = saturate_f8_nan_codes(f8)
+    bits = np.asarray(jax.lax.bitcast_convert_type(out, jnp.uint8))
+    want = np.asarray(codes).copy()
+    want[0x7F] = 0x7E                  # +NaN -> +448
+    want[0xFF] = 0xFE                  # -NaN -> -448
+    np.testing.assert_array_equal(bits, want)
+    assert not np.isnan(np.asarray(out, np.float32)).any()
+    # non-f8 inputs pass through untouched (the guard is dtype-gated)
+    x32 = jnp.asarray([1.0, float("nan")], jnp.float32)
+    assert saturate_f8_nan_codes(x32) is x32
+
+
+def test_f8_session_restore_sanitizes_nan_codes(tmp_path):
+    """A session file whose f8 cache bytes carry the NaN code (a
+    non-saturating foreign producer) must restore to a NaN-free cache:
+    Engine.load_session runs the seed guard, so the 0x7F pattern can
+    never reach the flash kernel's _f8_bits_to."""
+    spec, ref, f8 = engines()
+    f8.step(np.asarray([PROMPT], np.int32), 0)
+    path = str(tmp_path / "sess.npz")
+    f8.save_session(path, tokens=PROMPT)
+
+    z = dict(np.load(path))
+    k0 = z["k0"].copy()                # stored as raw uint8 bit patterns
+    k0[..., 0] = 0x7F                  # poison: e4m3 NaN at position 0..
+    k0[..., 1] = 0xFF                  # ..both signs
+    z["k0"] = k0
+    with open(path, "wb") as f:
+        np.savez(f, **z)
+
+    restored = Engine(spec, load_params(
+        spec, dense_weights(spec, seed=5)[0], mode="q40",
+        dtype=jnp.float32), compute_dtype=jnp.float32,
+        cache_dtype=jnp.float8_e4m3fn, use_pallas=False)
+    restored.model_fingerprint = f8.model_fingerprint
+    restored.load_session(path)
+    bits = np.asarray(jax.lax.bitcast_convert_type(
+        restored.cache.k[0], jnp.uint8))
+    assert not ((bits & 0x7F) == 0x7F).any()
+    # the restored cache decodes finite everywhere a forward will read
+    logits = restored.step(np.asarray([[5]], np.int32), restored.pos)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_f8_bits_reassembly_exact_all_codes():
     """_f8_bits_to (the in-kernel e4m3->bf16/f32 reassembly that replaced
     Mosaic's slow fp8 astype — tools/exp_f8_flash.py) must agree with the

@@ -1,0 +1,345 @@
+"""Radix prefix cache (runtime/prefix_cache.py): cross-request KV reuse.
+
+The contracts under test: a prefix-cache HIT seeds a slot from arena
+blocks and the greedy output stays TOKEN-IDENTICAL to a cold sequential
+``Engine.generate`` run (seeded K/V is bitwise the K/V a cold prefill
+would have written — exact-token-match at identical absolute positions,
+same jitted programs); lookups return WHOLE blocks only and never cover
+the entire prompt; eviction under a full pool can never free a block an
+in-flight slot is pinned to; and a supervisor rebuild starts from an
+EMPTY tree (the arena dies with the engine). f32 on CPU so the seeded
+rows compare bit-exactly against the oracle (same discipline as
+tests/test_scheduler.py).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.models.params import load_params, random_tensors
+from distributed_llama_tpu.runtime.engine import Engine
+from distributed_llama_tpu.runtime.faults import FAULTS
+from distributed_llama_tpu.runtime.prefix_cache import PrefixCache
+from distributed_llama_tpu.runtime.resilience import EngineSupervisor
+from distributed_llama_tpu.runtime.scheduler import RequestError, Scheduler
+from distributed_llama_tpu.sampler import Sampler
+
+SEQ = 64
+SYS = [7, 9, 23, 54, 11, 3, 88, 61]  # the shared "system prompt": 2 blocks of 4
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=SEQ,
+                     hidden_act=HiddenAct.SILU)
+    host = random_tensors(spec, seed=3, scale=0.05)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    return spec, params
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _oracle(spec, params, prompt, max_tokens):
+    eng = Engine(spec, params, batch=1, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    return eng.generate(prompt, max_tokens,
+                        Sampler(spec.vocab_size, temperature=0.0, topp=0.9,
+                                seed=1)).tokens
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+
+
+def _sched(spec, params, *, batch=2, blocks=16, block_len=4, chunk=4):
+    eng = Engine(spec, params, batch=batch, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    pc = PrefixCache(eng, num_blocks=blocks, block_len=block_len)
+    return Scheduler(eng, chunk=chunk, prefix_cache=pc), pc
+
+
+def _run(sched, req, limit=500):
+    for _ in range(limit):
+        if req.finished.is_set():
+            return list(req.tokens(timeout=5.0))
+        sched.step()
+    raise AssertionError("scheduler did not finish the request")
+
+
+def test_hit_parity_vs_cold_prefill(tiny):
+    """A prefix-cache hit (seeded blocks + suffix prefill) must emit
+    EXACTLY the cold run's greedy tokens — the seeded rows sit on the
+    exact logits path of every subsequent forward, so token parity here
+    is the end-to-end bit-exactness proof for the whole
+    publish -> arena -> seed -> attend pipeline."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params)
+    pA = SYS + [101, 5, 17]
+    pB = SYS + [40, 77]
+
+    rA = sched.submit(pA, 6, _greedy(spec))
+    assert _run(sched, rA) == _oracle(spec, params, pA, 6)
+    assert pc.stats.hits == 0 and pc.stats.blocks_published >= 2
+
+    rB = sched.submit(pB, 6, _greedy(spec))
+    assert _run(sched, rB) == _oracle(spec, params, pB, 6)
+    assert pc.stats.hits == 1
+    assert pc.stats.tokens_saved == len(SYS)  # both shared blocks seeded
+    s = sched.stats.summary()
+    assert s["prefix_cache"]["hit_rate"] == 0.5
+    assert s["prefix_cache"]["tokens_saved"] == len(SYS)
+
+
+def test_partial_block_returns_whole_blocks_only(tiny):
+    """A prefix sharing a non-block-aligned number of tokens matches only
+    its WHOLE blocks (partial blocks are never indexed), and a prompt
+    EQUAL to a cached prefix is capped at len - 1 so the finishing chunk
+    still samples real logits."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params)
+    base = SYS + [33, 2]  # 10 tokens: 2 whole blocks + 2 remainder
+    r0 = sched.submit(base, 3, _greedy(spec))
+    _run(sched, r0)
+
+    # shares 9 tokens with `base` -> only 2 whole blocks (8 tokens) seed
+    p1 = base[:9] + [90, 14]
+    r1 = sched.submit(p1, 4, _greedy(spec))
+    assert _run(sched, r1) == _oracle(spec, params, p1, 4)
+    assert pc.stats.tokens_saved == 8
+
+    # the EXACT cached prompt (10 tokens): usable = (10 - 1) // 4 = 2
+    # blocks again, never the full prompt — and parity still holds
+    r2 = sched.submit(list(base), 4, _greedy(spec))
+    assert _run(sched, r2) == _oracle(spec, params, base, 4)
+    assert pc.stats.tokens_saved == 16
+    assert pc.stats.hits == 2
+
+
+def test_refcount_protected_eviction_under_full_pool(tiny):
+    """With every pool block pinned by an in-flight slot, a publish that
+    needs a block DROPS (publish_drops) instead of evicting — eviction
+    must never free a block a live slot was seeded from. Once the pin is
+    released, the same pressure evicts the LRU leaf."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params, blocks=2)  # pool == the shared prefix
+    p_shared = SYS + [101]
+    other = [2, 40, 77, 12, 9, 31, 66, 90]      # a disjoint 2-block prompt
+
+    r0 = sched.submit(p_shared, 1, _greedy(spec))
+    _run(sched, r0)
+    assert pc.stats.blocks_published == 2 and not pc._free
+
+    # r1 seeds from both blocks and HOLDS them pinned while it decodes
+    r1 = sched.submit(p_shared, 30, _greedy(spec))
+    for _ in range(6):
+        sched.step()
+    assert not r1.finished.is_set() and pc.stats.hits == 1
+
+    # r2 finishes while r1 is in flight; its publish finds the pool full
+    # of PINNED blocks -> dropped, nothing evicted, r1's source survives
+    r2 = sched.submit(other, 1, _greedy(spec))
+    while not r2.finished.is_set():
+        sched.step()
+    assert pc.stats.publish_drops >= 1
+    assert pc.stats.evictions == 0
+    assert len(pc._walk(p_shared, 2)) == 2  # both blocks still indexed
+
+    while not r1.finished.is_set():
+        sched.step()
+    assert _run(sched, r1) == _oracle(spec, params, p_shared, 30)
+
+    # pins released: the same pressure now evicts the LRU leaf
+    r3 = sched.submit(other, 1, _greedy(spec))
+    _run(sched, r3)
+    assert pc.stats.evictions >= 1
+
+
+def test_publish_never_evicts_its_own_walk_path(tiny):
+    """A publish whose allocation pressure lands on the pool it is
+    standing on must DROP, not evict a walk-path node — evicting one
+    would attach the next block under a detached parent, leaking an
+    unreachable subtree (found by review). Scenario: the pool holds
+    exactly prompt A's two blocks; a longer prompt EXTENDING A dedups
+    through them and then needs a third — its only eviction candidate
+    is A's leaf, the node the walk stands on."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params, blocks=2)
+    prompt_a = SYS                    # exactly 2 blocks of 4
+    prompt_b = SYS + [5, 17, 40, 77]  # extends A by one more block
+    r0 = sched.submit(prompt_a + [101], 1, _greedy(spec))
+    _run(sched, r0)
+    assert pc.stats.blocks_in_use == 2 and not pc._free
+
+    rb = sched.submit(prompt_b + [33], 1, _greedy(spec))
+    _run(sched, rb)
+    # B's third block was dropped (the only candidate was its own walk
+    # path); A's chain stayed reachable and nothing leaked
+    assert len(pc._walk(prompt_b, 3)) == 2
+    assert pc.stats.publish_drops >= 1 and pc.stats.evictions == 0
+    assert pc.stats.blocks_in_use == 2 and not pc._free
+
+    # with no walk in flight, unrelated pressure can still evict
+    r2 = sched.submit([2, 6, 10, 14, 18, 22, 26, 30], 1, _greedy(spec))
+    _run(sched, r2)
+    assert pc.stats.evictions >= 1
+
+
+def test_supervisor_rebuild_invalidates_tree(tiny):
+    """Crash recovery (runtime/faults.py step_raise through the
+    EngineSupervisor) must start the new generation from an EMPTY tree:
+    the arena died with the engine, so nothing the old generation
+    published may seed a rebuilt engine's slots — and requests after
+    recovery still hit full greedy parity from the fresh cache."""
+    spec, params = tiny
+
+    def factory():
+        return Engine(spec, params, batch=2, compute_dtype=jnp.float32,
+                      cache_dtype=jnp.float32)
+
+    sup = EngineSupervisor(factory, chunk=8, stall_timeout=60.0,
+                           backoff_base=0.01, prefix_blocks=16,
+                           prefix_block_len=4)
+    try:
+        prompt = SYS + [101, 5]
+        r0 = sup.submit(prompt, 3, _greedy(spec))
+        assert list(r0.tokens(timeout=30.0)) == _oracle(spec, params,
+                                                        prompt, 3)
+        pc_old = sup.prefix_cache
+        assert pc_old.stats.blocks_published >= 2
+
+        FAULTS.arm("step_raise")  # next step crashes mid-generation
+        r1 = sup.submit(prompt, 8, _greedy(spec))
+        with pytest.raises(RequestError):
+            list(r1.tokens(timeout=30.0))
+
+        end = __import__("time").perf_counter() + 30.0
+        while (__import__("time").perf_counter() < end
+               and sup.sup_stats.recoveries < 1):
+            __import__("time").sleep(0.01)
+        assert sup.sup_stats.recoveries == 1
+
+        pc_new = sup.prefix_cache
+        assert pc_new is not pc_old
+        assert pc_old.stats.invalidations >= 1  # abort dropped the tree
+        assert pc_new.stats.blocks_in_use == 0 and pc_new.stats.lookups == 0
+        assert not pc_new._root.children
+
+        # the rebuilt generation serves the same prompt from COLD (no
+        # cross-generation seeding) and re-warms its own tree
+        r2 = sup.submit(prompt, 3, _greedy(spec))
+        assert list(r2.tokens(timeout=30.0)) == _oracle(spec, params,
+                                                        prompt, 3)
+        assert pc_new.stats.hits == 0 and pc_new.stats.blocks_published >= 2
+    finally:
+        sup.close()
+
+
+def test_late_unpin_after_invalidate_cannot_double_allocate(tiny):
+    """unpin() arriving AFTER invalidate() (a straggler path releasing a
+    dead generation's pins) must not resurrect a detached node into the
+    eviction heap: its block id is also on the rebuilt free list, and
+    evicting it would hand the same arena block to two live nodes
+    (found by review — depth >= 2 nodes keep their parent link, so the
+    attachment check alone passes; the epoch stamp catches them)."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params, blocks=2)
+    prompt = SYS + [101]  # 2 blocks: a depth-2 chain
+    r0 = sched.submit(prompt, 1, _greedy(spec))
+    _run(sched, r0)
+    n, ids, pins = pc.lookup_pin(prompt)
+    assert n == len(SYS) and len(pins) == 2
+
+    pc.invalidate()
+    pc.unpin(pins)  # late release of pre-invalidate pins
+
+    # drain the rebuilt free list, then demand one more block: the
+    # detached depth-2 node must NOT be evictable (drop, not a second
+    # hand-out of a block the free list already served)
+    blocks = [pc._alloc() for _ in range(2)]
+    assert sorted(blocks) == [0, 1]
+    assert pc._alloc() is None
+    assert pc.stats.evictions == 0 and pc.stats.blocks_in_use == 0
+
+
+def test_cancel_and_deadline_release_pins(tiny):
+    """Every slot-release path (cancel mid-decode, deadline expiry) must
+    release its seed pins — a leaked pin would make its blocks
+    permanently unevictable."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params)
+    r0 = sched.submit(SYS + [101], 1, _greedy(spec))
+    _run(sched, r0)
+
+    r1 = sched.submit(SYS + [40], 30, _greedy(spec))
+    for _ in range(5):
+        sched.step()
+    assert pc.stats.hits == 1
+    r1.cancel()
+    sched.step()
+    assert r1.finished.is_set() and r1.finish_reason == "cancelled"
+    assert all(not s.pins for s in sched.slots)
+    assert all(n.refs == 0 for n in pc._root.children.values())
+
+    import time as _t
+    r2 = sched.submit(SYS + [77], 30, _greedy(spec),
+                      deadline=_t.perf_counter() + 0.15)
+    for _ in range(5):
+        sched.step()
+    _t.sleep(0.2)
+    sched.step()  # reaps the expired request
+    assert r2.finished.is_set()
+    assert all(not s.pins for s in sched.slots)
+    assert all(n.refs == 0 for n in pc._root.children.values())
+
+
+def test_eviction_heap_stays_bounded(tiny):
+    """The lazy eviction heap must not grow one stale entry per request
+    forever on a server whose pool never fills (eviction pops — the
+    normal stale-entry drain — never run while the free list serves):
+    pushes past the bound trigger compaction back to live candidates."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params, blocks=2)
+    r = sched.submit(SYS + [101], 1, _greedy(spec))
+    _run(sched, r)
+    for _ in range(200):  # steady-state churn: pin + unpin, no eviction
+        _, _, pins = pc.lookup_pin(SYS + [40])
+        pc.unpin(pins)
+    assert len(pc._heap) <= max(4 * pc.num_blocks, 64) + 1
+
+
+def test_warmup_on_full_pool_preserves_published_blocks(tiny):
+    """Re-warming a long-lived scheduler whose pool is fully allocated
+    must not clobber a live block's K/V (warmup's scratch publish only
+    targets blocks still on the free list; with none free it is
+    skipped) — a same-prefix request afterwards still seeds bit-exact."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params, blocks=2)
+    r0 = sched.submit(SYS + [101], 1, _greedy(spec))
+    _run(sched, r0)
+    assert not pc._free  # both blocks live
+    sched.warmup()       # idle scheduler, full pool: publish skipped
+    p = SYS + [40, 77]
+    r1 = sched.submit(p, 4, _greedy(spec))
+    assert _run(sched, r1) == _oracle(spec, params, p, 4)
+    assert pc.stats.hits == 1
+
+
+def test_warmup_is_state_neutral(tiny):
+    """Scheduler.warmup with the prefix cache attached compiles the seed
+    and publish executables without perturbing later outputs (the
+    supervisor warms rebuilt engines this way before READY)."""
+    spec, params = tiny
+    sched, pc = _sched(spec, params)
+    sched.warmup()
+    assert pc.stats.blocks_in_use == 0  # nothing indexed by warmup
+    p = SYS + [101, 5, 17]
+    r = sched.submit(p, 6, _greedy(spec))
+    assert _run(sched, r) == _oracle(spec, params, p, 6)
