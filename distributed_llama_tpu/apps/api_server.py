@@ -46,6 +46,13 @@ from ..runtime.scheduler import PromptTooLong, QueueFull, RequestError
 
 CHAT_EOS_MARKERS = ("<|eot_id|>", "<|end_of_text|>")
 
+# SSE keepalive cadence for collected (non-streaming-engine) paths: the
+# batch endpoint's greedy+lookup path buffers all rows before the first
+# data event, so comment frames (": keepalive") flow while it collects —
+# a long generation must not trip client/proxy idle timeouts (ADVICE r5
+# low). Comments are protocol-invisible to SSE clients. Tests shrink this.
+KEEPALIVE_SECS = 1.0
+
 
 class BadRequest(ValueError):
     """Deterministic client-input error (malformed temperature/seed/stop/
@@ -109,6 +116,10 @@ class ApiState:
         # accept loop (the scheduler path needs no lock — it queues)
         self.engine_lock = threading.RLock()
         self._scheduler = None
+        # multihost root: set to the ClusterPeerLost when the control
+        # plane detects a dead/wedged worker — /readyz answers 503
+        # cluster_lost during the brief window before the diagnostic exit
+        self.cluster_lost = None
 
     def scheduler(self):
         """The SUPERVISED continuous-batching front door
@@ -444,6 +455,7 @@ def _batch_completion_chunks(state: ApiState, body: dict):
                 "(server started with --serve-batch "
                 f"{state.serve_batch})")
         max_tokens = int(body.get("max_tokens", 64))
+        want_stream = bool(body.get("stream", False))
         stops = body.get("stop") or []
         if isinstance(stops, str):
             stops = [stops]
@@ -531,11 +543,52 @@ def _batch_completion_chunks(state: ApiState, body: dict):
                 # sequences trim each row post-hoc — a stopped row may
                 # have burned some extra forwards, which multi-token
                 # accepts more than repay; the batch cache resets per
-                # request, so the overrun positions leak nothing
-                outs = engine.generate_batch_lookup(
-                    rows, n_gen, eos_id=tokenizer.eos_id,
-                    draft_len=state.lookup_decode,
-                    vocab_size=tokenizer.vocab_size, stop_flags=stop_flags)
+                # request, so the overrun positions leak nothing.
+                # For STREAMING requests the collect runs on a helper
+                # thread so keepalive events flow meanwhile (first byte
+                # within KEEPALIVE_SECS, not full batch completion —
+                # ADVICE r5). Non-streaming requests collect inline: a
+                # keepalive has no one to reach, and keeping the whole
+                # collect before the first yield preserves the clean
+                # 400/503 mapping at the handler's next(gen)
+                if want_stream:
+                    box: dict = {}
+
+                    def _collect():
+                        try:
+                            box["outs"] = engine.generate_batch_lookup(
+                                rows, n_gen, eos_id=tokenizer.eos_id,
+                                draft_len=state.lookup_decode,
+                                vocab_size=tokenizer.vocab_size,
+                                stop_flags=stop_flags)
+                        except BaseException as e:  # noqa: BLE001 —
+                            box["err"] = e  # re-raised on the generator
+                    t = threading.Thread(target=_collect, daemon=True)
+                    t.start()
+                    try:
+                        while True:
+                            t.join(timeout=KEEPALIVE_SECS)
+                            if not t.is_alive():
+                                break
+                            yield ("keepalive", None)
+                    finally:
+                        # a torn-down generator (client disconnect) must
+                        # NOT release the exclusive borrow while the
+                        # collect thread still drives the engine — block
+                        # until done
+                        t.join()
+                    if "err" in box:
+                        # inside the exclusive borrow: engine failures
+                        # walk the same supervisor recovery as the sync
+                        # path did
+                        raise box["err"]
+                    outs = box["outs"]
+                else:
+                    outs = engine.generate_batch_lookup(
+                        rows, n_gen, eos_id=tokenizer.eos_id,
+                        draft_len=state.lookup_decode,
+                        vocab_size=tokenizer.vocab_size,
+                        stop_flags=stop_flags)
                 for i in range(b):
                     for tok in outs[i]:
                         piece = scan_token(i, tok)
@@ -700,13 +753,20 @@ def make_handler(state: ApiState):
                 # stats read must never be the thing that allocates the
                 # batched cache — report idle until a request builds it.
                 if state.serve_batch <= 0:
-                    self._json(200, {"scheduler": "off"})
+                    payload = {"scheduler": "off"}
                 elif state._scheduler is None:
-                    self._json(200, {"scheduler": "idle"})
+                    payload = {"scheduler": "idle"}
                 else:
                     # supervisor summary: scheduler counters (totals carried
                     # across recoveries) + the resilience block
-                    self._json(200, state._scheduler.summary())
+                    payload = state._scheduler.summary()
+                # multihost root: the control-plane block (heartbeat
+                # counters, peer losses, phase — runtime/stats.ClusterStats)
+                from ..parallel.multihost import cluster_summary
+                cluster = cluster_summary()
+                if cluster is not None:
+                    payload["cluster"] = cluster
+                self._json(200, payload)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -714,7 +774,14 @@ def make_handler(state: ApiState):
             """Readiness = engine healthy AND queue under bound (and not
             draining). 503 + Retry-After otherwise — the load balancer's
             signal to route elsewhere."""
-            if state.draining:
+            if state.cluster_lost is not None:
+                # a cluster peer is gone: this replica cannot serve until
+                # an operator restores it (the process is about to take
+                # its diagnostic exit — answer honestly meanwhile)
+                self._json(503, {"status": "cluster_lost",
+                                 "detail": state.cluster_lost.summary()},
+                           retry_after=30.0)
+            elif state.draining:
                 self._json(503, {"status": "draining"}, retry_after=1.0)
             elif state.serve_batch <= 0:
                 # legacy single-engine server: always ready (requests
@@ -795,13 +862,32 @@ def make_handler(state: ApiState):
             if stream:
                 self._sse_start()
                 usage = None
-                for kind, payload in events():
-                    if kind == "piece":
-                        i, piece = payload
-                        self._sse(_chunk_env(rid, created, state.model_name,
-                                             i, {"content": piece}, None))
-                    else:
-                        usage = payload
+                try:
+                    for kind, payload in events():
+                        if kind == "piece":
+                            i, piece = payload
+                            self._sse(_chunk_env(rid, created,
+                                                 state.model_name,
+                                                 i, {"content": piece},
+                                                 None))
+                        elif kind == "keepalive":
+                            # SSE comment frame: bytes on the wire while
+                            # the collected lookup path runs, invisible
+                            # to the client's event parser
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                        else:
+                            usage = payload
+                except Exception as e:  # noqa: BLE001 — an engine crash
+                    # AFTER the 200/SSE start (e.g. surfacing behind the
+                    # keepalives): same mid-stream contract as the
+                    # scheduler path — an explicit structured error event
+                    # and a terminated stream, never a dropped connection
+                    # (supervisor recovery already ran via exclusive())
+                    self._sse({"error": f"engine failure: "
+                                        f"{type(e).__name__}: {e}"})
+                    self._sse_done()
+                    return
                 for i, fr in enumerate(usage["finish_reasons"]):
                     self._sse(_chunk_env(rid, created, state.model_name,
                                          i, {}, fr))
@@ -814,7 +900,7 @@ def make_handler(state: ApiState):
                 if kind == "piece":
                     i, piece = payload
                     texts[i] = texts.get(i, "") + piece
-                else:
+                elif kind == "done":
                     usage = payload
             self._json(200, _completion_env(
                 rid, created, state.model_name,
@@ -1027,6 +1113,27 @@ def serve(args) -> None:
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "
               f"({engine.pos} cached positions)")
+    if jax.process_count() > 1:
+        # multihost api root: a lost worker means every future forward
+        # would hang in an orphaned collective. Map the detection onto the
+        # supervisor's BROKEN path first (structured cluster_peer_lost
+        # error frames to anything in flight, circuit open) — were a
+        # cluster-capable scheduler ever live — flip /readyz to 503
+        # cluster_lost, give handler threads a beat to flush those frames,
+        # then take the standard diagnostic exit (43): an orchestrator
+        # restart beats a zombie that 503s forever
+        from ..parallel import multihost as mh
+
+        def _on_peer_lost(exc):
+            state.cluster_lost = exc
+            sup = state._scheduler
+            if sup is not None:
+                sup.trip_cluster(exc)
+            time.sleep(0.5)
+            mh.diagnostic_exit(exc)
+
+        mh.install_peer_lost_exit(_on_peer_lost)
+        mh.set_phase("serve")
     # threaded accept loop (daemon handler threads): the scheduler path
     # serves concurrent clients from one batched decode; legacy paths
     # serialize on state.engine_lock / Scheduler.exclusive

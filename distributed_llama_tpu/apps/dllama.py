@@ -52,7 +52,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="must match the model file (ref: app.cpp:47-48)")
     p.add_argument("--buffer-float-type", default="q80", choices=["f32", "q80"],
                    help="activation exchange dtype (q80 reproduces the "
-                        "reference's quantized wire buffers, ref: app.cpp:49-50)")
+                        "reference's quantized wire buffers, ref: app.cpp:49-50). "
+                        "NOT honored with --pp > 1: pipeline stages reduce "
+                        "with GSPMD-exact collectives (the quantized "
+                        "exchange cannot nest inside the manual-pp region), "
+                        "so q80 is ignored there and f32 exact collectives "
+                        "run instead")
     p.add_argument("--nthreads", type=int, default=None,
                    help="accepted for reference CLI parity; XLA manages "
                         "device parallelism (ref: app.cpp:84)")
@@ -70,7 +75,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "holds n_experts/ep experts)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel mesh size (each device holds "
-                        "n_layers/pp layers and their KV cache)")
+                        "n_layers/pp layers and their KV cache). Contract "
+                        "exclusions: --session is refused (stage-stacked "
+                        "pp caches are not host-fetchable) and "
+                        "--buffer-float-type q80 is ignored in favor of "
+                        "exact f32 collectives")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--cache-dtype", default="bf16",
@@ -212,6 +221,31 @@ def build_argparser() -> argparse.ArgumentParser:
                         "per-worker TCP weight push, transformer.cpp:562-"
                         "591). Pass on EVERY process; workers may omit "
                         "--model")
+    # cluster control-plane resilience flags (parallel/multihost.py,
+    # docs/operations.md "Cluster failure modes"). The root's
+    # --heartbeat-interval / --worker-timeout are authoritative: workers
+    # adopt them from the HELLO ack, so only the root's values matter
+    p.add_argument("--connect-timeout", type=float, default=30.0,
+                   metavar="SECS",
+                   help="cluster formation budget: workers retry the "
+                        "root's control port with exponential backoff "
+                        "until this deadline, and the root waits this "
+                        "long for every worker's versioned HELLO — past "
+                        "it, a structured formation error (exit 44), "
+                        "never a silent hang")
+    p.add_argument("--heartbeat-interval", type=float, default=2.0,
+                   metavar="SECS",
+                   help="root->worker MSG_PING cadence on the control "
+                        "channel (workers answer MSG_PONG; both sides "
+                        "time out silent peers)")
+    p.add_argument("--worker-timeout", type=float, default=10.0,
+                   metavar="SECS",
+                   help="peer-loss detection bound: a node silent on the "
+                        "control channel this long (dead, wedged, or "
+                        "partitioned) is declared lost with a structured "
+                        "ClusterPeerLost diagnostic (exit 43) instead of "
+                        "hanging a collective forever; must comfortably "
+                        "exceed --heartbeat-interval")
     return p
 
 
@@ -396,6 +430,7 @@ def _announce_run(tokens: list[int], max_tokens: int, reset: bool = False,
     in lock-step."""
     if jax.process_count() > 1:
         from ..parallel import multihost as mh
+        mh.set_phase("run")
         mh.send_run(tokens, max_tokens,
                     sampler.rng_state if sampler else 0,
                     sampler.temperature if sampler else 0.0,
@@ -583,14 +618,21 @@ def _print_benchmark(args, engine, res, trace_dir=None) -> None:
     time from the trace (falling back to the all-reduce microbench scaled
     to the per-layer reduce count — netstats.py)."""
     wire = engine.wire_estimate()
-    if jax.process_count() > 1:
-        from ..parallel import multihost as mh
-        mh.send_xfer_bench()  # workers join the collective microbench
-    t_ms = engine.measure_transfer_ms()
     # the first stats step is the whole prefill: its fallback T follows the
     # schedule prefill actually ran (GPipe ppermute hops on pp meshes —
     # engine.measure_prefill_transfer_ms), not the per-token decode model
     n_prompt = max(engine.pos - (len(res.tokens) - 1), 1)
+    if jax.process_count() > 1:
+        # workers join the IDENTICAL microbench sequence: n_prompt rides
+        # the header so their measure_prefill_transfer_ms runs the same
+        # per-segment collectives (incl. pp ppermute) as ours — the root
+        # measuring a collective the workers skip deadlocks the mesh
+        # (ADVICE r5 high; regression: tests/test_multihost.py
+        # test_two_process_benchmark_completes)
+        from ..parallel import multihost as mh
+        mh.set_phase("bench")
+        mh.send_xfer_bench(n_prompt)
+    t_ms = engine.measure_transfer_ms()
     t_pre_ms = engine.measure_prefill_transfer_ms(n_prompt)
     t_steps: list[float] = []
     if trace_dir:
@@ -735,11 +777,18 @@ def cmd_worker(args) -> None:
     print(f"⏳ worker rank {jax.process_index()} of {jax.process_count()} "
           "ready")
     while True:
+        mh.set_phase("idle")
+        # supervised wait: a root that dies or wedges surfaces as a
+        # structured ClusterPeerLost within --worker-timeout (the link's
+        # receiver thread also hard-exits via the installed handler when
+        # this thread is itself wedged in a collective) — never the
+        # reference's unbounded socket read
         msg = mh.recv_msg()
         if msg.kind == mh.MSG_SHUTDOWN:
             print("🔌 root shut down — exiting")
             return
         if msg.kind == mh.MSG_RUN:
+            mh.set_phase("run")
             if msg.reset:
                 engine.reset()
             if msg.lookup:
@@ -775,6 +824,7 @@ def cmd_worker(args) -> None:
                     engine.generate(msg.tokens, msg.max_tokens, run_sampler,
                                     eos_id=stops)
         elif msg.kind == mh.MSG_API:
+            mh.set_phase("api")
             # replay the root's API request end-to-end from the raw body —
             # prompt build, sampling, stop scan are all deterministic
             import json
@@ -802,7 +852,16 @@ def cmd_worker(args) -> None:
                 api_state.cached_tokens = []
                 engine.reset()
         elif msg.kind == mh.MSG_XFER_BENCH:
+            # the EXACT sequence the root runs in _print_benchmark —
+            # decode microbench THEN the prefill-schedule microbench for
+            # the header's n_prompt (ADVICE r5 high: the old handler
+            # stopped after measure_transfer_ms, so the root's prefill
+            # collectives had no worker counterpart and --benchmark hung
+            # the cluster)
+            mh.set_phase("bench")
             engine.measure_transfer_ms()
+            engine.measure_prefill_transfer_ms(max(msg.max_tokens, 1))
+            mh.set_phase("idle")
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -812,6 +871,18 @@ def main(argv: list[str] | None = None) -> None:
                  "TCP root/worker star is one SPMD program here; use --tp N "
                  "for one host's devices, or --nnodes/--coordinator + "
                  "`dllama worker` processes for a multi-host cluster")
+    # pp contract holes closed at PARSE time, before any engine or cluster
+    # work: a flag combination that cannot work must not cost a model load
+    # (or, worse, be silently ignored for a whole run)
+    if args.session and args.pp > 1:
+        sys.exit("error: --session does not compose with --pp > 1 — "
+                 "save_session fetches the KV cache to the host, and "
+                 "stage-stacked pipeline caches are not host-fetchable "
+                 "(see docs/parallelism.md)")
+    if args.session and args.nnodes > 1:
+        sys.exit("error: --session does not compose with --nnodes > 1 — "
+                 "a multi-process mesh's cache shards are not addressable "
+                 "from one host")
     if args.nnodes > 1:
         if not args.coordinator:
             sys.exit("error: --nnodes > 1 requires --coordinator host:port")
@@ -819,8 +890,36 @@ def main(argv: list[str] | None = None) -> None:
             sys.exit("error: rank 0 is the root — run a non-worker mode")
         if args.mode != "worker" and args.node_rank != 0:
             sys.exit("error: non-root ranks must run `dllama worker`")
-        from ..parallel.multihost import init_multihost
-        init_multihost(args.coordinator, args.nnodes, args.node_rank)
+        if args.heartbeat_interval <= 0 or args.worker_timeout <= 0:
+            sys.exit("error: --heartbeat-interval and --worker-timeout "
+                     "must be positive")
+        if args.worker_timeout < 2 * args.heartbeat_interval:
+            # healthy workers only produce frames in response to PINGs: a
+            # detection bound under ~2 pings declares live nodes dead on
+            # one delayed heartbeat — a self-destructing config, refused
+            # up front like the other flag-contract holes above
+            sys.exit(f"error: --worker-timeout {args.worker_timeout:g} "
+                     "must be at least 2x --heartbeat-interval "
+                     f"({args.heartbeat_interval:g}) — a node is only "
+                     "expected to produce a frame per heartbeat, so a "
+                     "tighter bound declares healthy peers lost "
+                     "(recommended: 3-5x)")
+        from ..parallel import multihost as mh
+        try:
+            mh.init_multihost(args.coordinator, args.nnodes, args.node_rank,
+                              connect_timeout=args.connect_timeout,
+                              heartbeat_interval=args.heartbeat_interval,
+                              worker_timeout=args.worker_timeout)
+        except mh.ClusterProtocolError as e:
+            print(f"🔴 cluster formation failed: {e}", flush=True)
+            sys.exit(mh.EXIT_FORMATION)
+        # peer loss during ANY later phase (weight load, a generate()'s
+        # collectives, idle) -> one structured diagnostic line + exit 43,
+        # fired from the link's detection thread — the only thread
+        # guaranteed not to be wedged inside the very collective the dead
+        # peer just orphaned
+        mh.install_peer_lost_exit()
+        mh.set_phase("load")
     elif args.mode == "worker":
         sys.exit("error: worker mode needs a cluster — pass --nnodes N "
                  "--node-rank r --coordinator host:port (single-host "
@@ -838,20 +937,29 @@ def main(argv: list[str] | None = None) -> None:
         elif args.mode == "api":
             from .api_server import serve
             serve(args)
-    except BaseException:
+    except BaseException as e:
         clean = False
+        if args.nnodes > 1:
+            from ..parallel.multihost import (EXIT_PEER_LOST,
+                                              ClusterPeerLost)
+            if isinstance(e, ClusterPeerLost):
+                # surfaced on the driving thread (a send/recv raced the
+                # detection threads' callback): same structured exit
+                import json
+                print("🔴 cluster: " + json.dumps(e.summary()), flush=True)
+                sys.exit(EXIT_PEER_LOST)
         raise
     finally:
-        if args.nnodes > 1 and args.mode != "worker":
-            # clean exit: workers are blocked in a header read, where the
-            # SHUTDOWN broadcast pairs cleanly (multihost.py framing). After
-            # a mid-run crash they may instead sit in step collectives — a
-            # shutdown broadcast would hang THIS process too, so skip it
-            # and rely on jax.distributed coordinator teardown to tear the
-            # workers down when the root process exits (ADVICE r2)
-            if clean:
-                from ..parallel import multihost as mh
+        if args.nnodes > 1:
+            from ..parallel import multihost as mh
+            if args.mode != "worker" and clean:
+                # clean exit: the SHUTDOWN frame reaches workers wherever
+                # they are (the control channel is out-of-band — no
+                # collective pairing needed). After a mid-run crash the
+                # heartbeat EOF tells them instead, within
+                # --worker-timeout, so no broadcast is required (or safe)
                 mh.send_shutdown()
+            mh.close_link()
 
 
 if __name__ == "__main__":

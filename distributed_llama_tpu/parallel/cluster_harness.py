@@ -1,0 +1,160 @@
+"""Two-process control-plane chaos harness.
+
+Drives the multihost control star (parallel/multihost.py RootLink /
+WorkerLink) WITHOUT a model, mesh, or jax.distributed cluster — pure
+host-side protocol — so the chaos tests (tests/test_cluster_chaos.py) and
+the bench cluster row (bench.py BENCH_CHAOS) can kill, stall, or corrupt
+either side of a real two-OS-process cluster and assert bounded detection
+in the NON-SLOW tier (no compiles, no fixtures; subprocess startup is the
+only cost).
+
+Every observable is one JSON line on stdout:
+
+  {"event": "formed", ...}            link up (worker reports its backoff
+                                      retry count)
+  {"event": "tick", "phase": ...}     worker received a phase-tick frame
+  {"event": "dying", "t_wall": ...}   worker about to os._exit(9)
+                                      (--die-after; the SIGKILL shape)
+  {"event": "cluster_peer_lost", ...} bounded detection fired
+                                      (ClusterPeerLost.summary() +
+                                      "t_wall") — process exits
+                                      EXIT_PEER_LOST (43)
+  {"event": "formation_failed", ...}  handshake/connect failure — exits
+                                      EXIT_FORMATION (44)
+  {"event": "complete" | "shutdown"}  clean end (root | worker), exit 0
+
+Faults are armed via DLLAMA_FAULTS in the child's environment (the
+registry loads it at import — runtime/faults.py): e.g.
+``recv_stall:after=2;times=0`` wedges a worker's receiver so it stops
+answering heartbeats, ``conn_refused:times=2`` fails the first two connect
+attempts to exercise the formation backoff.
+
+Usage:
+  python -m distributed_llama_tpu.parallel.cluster_harness root \
+      --port 19000 --nnodes 2 --heartbeat-interval 0.2 --worker-timeout 1.5 \
+      --phases formation:0.2,prefill:8
+  python -m distributed_llama_tpu.parallel.cluster_harness worker \
+      --host 127.0.0.1 --port 19000 --rank 1 --nnodes 2 [--die-after 0.8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+from . import multihost as mh
+
+
+def _emit(event: str, **fields) -> None:
+    # the harness's whole OUTPUT is these JSON lines — host CLI, not
+    # kernel debug leftovers
+    print(json.dumps({"event": event, "t_wall": time.time(), **fields}),  # dlgrind: ignore[DLG106]
+          flush=True)
+
+
+def _exit_on_peer_lost(exc: mh.ClusterPeerLost) -> None:
+    _emit(**exc.summary())
+    os._exit(mh.EXIT_PEER_LOST)
+
+
+def _parse_phases(spec: str) -> list[tuple[str, float]]:
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, secs = part.partition(":")
+        out.append((name, float(secs or 1.0)))
+    return out
+
+
+def run_root(args) -> int:
+    link = mh.RootLink(args.nnodes, "", args.port,
+                       heartbeat_interval=args.heartbeat_interval,
+                       worker_timeout=args.worker_timeout,
+                       connect_timeout=args.connect_timeout)
+    try:
+        link.form()
+    except mh.ClusterProtocolError as e:
+        _emit("formation_failed", error=str(e))
+        return mh.EXIT_FORMATION
+    mh.set_link(link)
+    link.on_peer_lost = _exit_on_peer_lost
+    _emit("formed", role="root", peers=sorted(link.peers))
+    for name, secs in _parse_phases(args.phases):
+        link.set_phase(name)
+        # a real protocol frame per phase so the broadcast path (and its
+        # lost-peer raise) is exercised, not just the heartbeat — the
+        # payload carries the phase name so the worker's diagnostics
+        # agree with the root's
+        mh._send(mh.MSG_RUN, bytes_payload=name.encode())
+        time.sleep(secs)
+    mh.send_shutdown()
+    _emit("complete", stats=link.summary())
+    link.close()
+    return 0
+
+
+def run_worker(args) -> int:
+    link = mh.WorkerLink(args.host, args.port, args.rank, args.nnodes,
+                         heartbeat_interval=args.heartbeat_interval,
+                         worker_timeout=args.worker_timeout,
+                         connect_timeout=args.connect_timeout,
+                         protocol_version=args.protocol_version)
+    try:
+        link.form()
+    except mh.ClusterProtocolError as e:
+        _emit("formation_failed", error=str(e))
+        return mh.EXIT_FORMATION
+    mh.set_link(link)
+    link.on_peer_lost = _exit_on_peer_lost
+    _emit("formed", role="worker", rank=args.rank,
+          retries=link.connect_retries,
+          heartbeat_interval=link.heartbeat_interval,
+          worker_timeout=link.worker_timeout)
+    if args.die_after is not None:
+        def die():
+            time.sleep(args.die_after)
+            _emit("dying")
+            os._exit(9)  # abrupt, like a SIGKILL/OOM — no FIN handshake code
+        threading.Thread(target=die, daemon=True).start()
+    while True:
+        msg = mh.recv_msg()
+        if msg.kind == mh.MSG_SHUTDOWN:
+            _emit("shutdown", stats=link.summary())
+            link.close()
+            return 0
+        if msg.kind == mh.MSG_RUN:
+            phase = (msg.body or b"?").decode()
+            link.set_phase(phase)
+            _emit("tick", phase=phase)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cluster_harness")
+    p.add_argument("role", choices=["root", "worker"])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--nnodes", type=int, default=2)
+    p.add_argument("--rank", type=int, default=1)
+    p.add_argument("--heartbeat-interval", type=float, default=0.25)
+    p.add_argument("--worker-timeout", type=float, default=2.0)
+    p.add_argument("--connect-timeout", type=float, default=10.0)
+    p.add_argument("--protocol-version", type=int,
+                   default=mh.PROTOCOL_VERSION,
+                   help="override to exercise the version-mismatch path")
+    p.add_argument("--phases", default="formation:0.2,idle:2.0",
+                   help="root: comma list of name:seconds cluster phases")
+    p.add_argument("--die-after", type=float, default=None,
+                   help="worker: os._exit(9) after this many seconds")
+    args = p.parse_args(argv)
+    try:
+        return run_root(args) if args.role == "root" else run_worker(args)
+    except mh.ClusterPeerLost as exc:  # surfaced on the driving thread
+        _emit(**exc.summary())
+        return mh.EXIT_PEER_LOST
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,25 @@ invariant under injection):
   * ``slow_step``     — scheduler step loop: sleeps ``ms`` per fire (the
                         degraded-but-alive shape deadlines must catch)
 
+Socket-layer sites, fired inside the multihost control-plane frame codec
+(parallel/multihost.py) so two-process chaos tests can kill or stall either
+side of the root<->worker star and assert bounded detection
+(tests/test_cluster_chaos.py):
+
+  * ``conn_refused``   — worker connect attempt: raises
+                         ``ConnectionRefusedError`` (exercises the
+                         cluster-formation retry/backoff path; ``times=K``
+                         fails the first K attempts deterministically)
+  * ``recv_stall``     — frame receive entry: blocks like ``step_stall``
+                         (a wedged peer that holds its socket open but
+                         stops reading — and so stops answering
+                         heartbeats; only the PING/PONG timeout detects it)
+  * ``frame_truncate`` — frame send: writes half the frame then closes the
+                         socket (the peer sees a mid-frame EOF — the
+                         torn-write shape)
+  * ``peer_close``     — frame send: closes the socket without writing
+                         (the abrupt-death shape at a protocol point)
+
 Arming is test-driven (``FAULTS.arm(...)``) or env-driven for subprocess
 harnesses (bench chaos rows, CI):
 
@@ -39,7 +58,8 @@ import dataclasses
 import os
 import threading
 
-SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step")
+SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
+         "conn_refused", "recv_stall", "frame_truncate", "peer_close")
 
 
 class FaultError(RuntimeError):
@@ -118,17 +138,32 @@ class FaultRegistry:
             if a is None or not a.should_fire():
                 return
             ms = a.ms
+        if site == "conn_refused":
+            # the REAL exception type the connect retry path handles — an
+            # injected refusal must walk the same backoff code as a root
+            # that is not up yet
+            raise ConnectionRefusedError(f"injected {site} (fire #{a.fired})")
         if site.endswith("_raise"):
             raise FaultError(f"injected {site} (fire #{a.fired})")
-        if site == "step_stall":
+        if site in ("step_stall", "recv_stall"):
             # block like the real hang: until released or ms elapses
-            # (default: effectively forever — the watchdog's job)
+            # (default: effectively forever — the watchdog's / the peer
+            # heartbeat timeout's job)
             self._release.wait(timeout=(ms / 1e3) if ms else 3600.0)
             return
         if site == "slow_step" and ms:
             import time
 
             time.sleep(ms / 1e3)
+
+    def triggered(self, site: str) -> bool:
+        """Count-deterministic QUERY form of ``fire()`` for sites whose
+        effect is mangling a socket rather than raising or stalling
+        (``frame_truncate``/``peer_close`` — the codec owns the socket and
+        performs the mangle itself). Consumes one invocation count."""
+        with self._lock:
+            a = self._armed.get(site)
+            return a is not None and a.should_fire()
 
     def load_env(self, env=None) -> None:
         """Parse ``DLLAMA_FAULTS`` (see module docstring). Malformed specs

@@ -233,6 +233,31 @@ class EngineSupervisor:
             time.sleep(0.02)
         return False
 
+    def trip_cluster(self, exc) -> None:
+        """Map a :class:`parallel.multihost.ClusterPeerLost` onto the
+        BROKEN path: the engine's mesh spans a process that is gone, so a
+        local rebuild cannot help — every in-flight/queued request gets a
+        structured ``cluster_peer_lost`` error frame immediately (instead
+        of hanging to its deadline in a collective that will never
+        complete) and the circuit opens without burning rebuild attempts.
+        ``reset_breaker()`` remains the operator's half-open once the
+        worker is back. Idempotent; callable from the link's detection
+        thread while the step thread is wedged (the abort path takes no
+        step mutex — Scheduler._abort_all)."""
+        with self._state_lock:
+            if self._state in (CLOSED, BROKEN):
+                return
+            self._gen += 1          # wedged/stale threads exit on wake
+            old = self._sched
+            old._stop = True
+            self._state = BROKEN
+            self.sup_stats.cluster_losses += 1
+            self.sup_stats.consecutive_failures = self.breaker_threshold
+        # retryable=False: the SAME replica cannot serve a retry until an
+        # operator (or orchestrator) restores the lost worker and resets
+        # the breaker — clients should fail over, not hammer
+        old._abort_all(str(exc), code="cluster_peer_lost", retryable=False)
+
     def reset_breaker(self) -> None:
         """Operator half-open: clear the failure streak and try one
         rebuild. No-op unless the breaker is open."""

@@ -186,6 +186,48 @@ class ServeStats:
 
 
 @dataclasses.dataclass
+class ClusterStats:
+    """Control-plane counters owned by parallel/multihost's link objects
+    (RootLink / WorkerLink): heartbeat traffic, formation retries, and the
+    structured record of every peer loss. Surfaced as the ``cluster``
+    block of GET /stats on a multihost api root, and by the chaos harness
+    (parallel/cluster_harness.py). The phase label is attached live by
+    ``multihost.cluster_summary()`` — it belongs to the link, not here."""
+
+    nnodes: int = 1
+    node_rank: int = 0
+    protocol_version: int = 0
+    heartbeat_interval_s: float = 0.0
+    worker_timeout_s: float = 0.0
+    connect_retries: int = 0   # worker side: backoff attempts at formation
+    pings_sent: int = 0        # root side
+    pongs_received: int = 0    # root side
+    pongs_sent: int = 0        # worker side
+    frames_sent: int = 0       # protocol frames (excl. pings)
+    frames_received: int = 0   # every frame (incl. heartbeat traffic)
+
+    def __post_init__(self):
+        # ClusterPeerLost.summary() dicts, in detection order
+        self.peers_lost: list = []
+
+    def summary(self) -> dict:
+        return {
+            "nnodes": self.nnodes,
+            "node_rank": self.node_rank,
+            "protocol_version": self.protocol_version,
+            "heartbeat_interval_s": self.heartbeat_interval_s,
+            "worker_timeout_s": self.worker_timeout_s,
+            "connect_retries": self.connect_retries,
+            "pings_sent": self.pings_sent,
+            "pongs_received": self.pongs_received,
+            "pongs_sent": self.pongs_sent,
+            "frames_sent": self.frames_sent,
+            "frames_received": self.frames_received,
+            "peers_lost": list(self.peers_lost),
+        }
+
+
+@dataclasses.dataclass
 class SupervisorStats:
     """Resilience counters owned by runtime/resilience.EngineSupervisor —
     they survive scheduler rebuilds (each recovery mints a fresh
@@ -196,6 +238,8 @@ class SupervisorStats:
     recoveries: int = 0       # successful rebuilds back to ready
     consecutive_failures: int = 0
     rejected_unready: int = 0  # submits refused while recovering/broken
+    cluster_losses: int = 0    # ClusterPeerLost escalations (trip_cluster):
+    # straight to BROKEN — no rebuild resurrects a remote worker
 
     def __post_init__(self):
         from collections import deque
@@ -212,6 +256,7 @@ class SupervisorStats:
             "recoveries": self.recoveries,
             "consecutive_failures": self.consecutive_failures,
             "rejected_unready": self.rejected_unready,
+            "cluster_losses": self.cluster_losses,
             "recovery_p50_ms": rnd(percentile(list(self.recovery_ms), 50)),
             "recovery_p99_ms": rnd(percentile(list(self.recovery_ms), 99)),
         }

@@ -27,6 +27,17 @@ def tiny_spec(weights_float_type: FloatType = FloatType.Q40,
     return ModelSpec(**base)
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared by the cluster tests, the
+    chaos harness spawners, and bench's cluster row — one home for the
+    bind-port-0 idiom)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def byte_fallback_vocab(vocab_size: int) -> list[bytes]:
     vocab = [b"<unk>", b"<s>", b"</s>"]
     vocab += [f"<0x{b:02X}>".encode() for b in range(256)]

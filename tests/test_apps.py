@@ -942,3 +942,156 @@ def test_api_batch_borrow_crash_triggers_recovery(api_batch_server):
     out = json.loads(resp.read())
     assert all(c["finish_reason"] in ("stop", "length")
                for c in out["choices"])
+
+
+def test_session_pp_contract_rejected_at_parse():
+    """VERDICT pp contract holes: --session with --pp > 1 (stage-stacked
+    caches are not host-fetchable) and with --nnodes > 1 must be refused
+    at PARSE time with a clear message — before any model load, cluster
+    connect, or silent ignore."""
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["generate", "--model", "m", "--tokenizer", "t",
+                     "--session", "s.bin", "--pp", "2"])
+    assert "--session" in str(ei.value) and "--pp" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["chat", "--model", "m", "--tokenizer", "t",
+                     "--session", "s.bin", "--pp", "4"])
+    assert "--pp" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["generate", "--model", "m", "--tokenizer", "t",
+                     "--session", "s.bin", "--nnodes", "2",
+                     "--coordinator", "127.0.0.1:1"])
+    assert "--nnodes" in str(ei.value)
+
+
+def test_help_surfaces_q80_pp_exclusion():
+    """The q80+pp collective exclusion must be discoverable from --help,
+    not only from a runtime notice mid-run."""
+    text = " ".join(dllama.build_argparser().format_help().split())
+    # --buffer-float-type documents that q80 is ignored under --pp
+    assert "q80 is ignored there" in text, text
+    assert "quantized exchange cannot nest" in text.lower()
+    # --pp documents both of its contract exclusions
+    assert "--session is refused" in text
+    # and the new cluster-resilience flags are documented
+    for flag in ("--connect-timeout", "--heartbeat-interval",
+                 "--worker-timeout"):
+        assert flag in text, flag
+
+
+def test_api_batch_lookup_streams_keepalives_before_completion(tmp_path,
+                                                               rng,
+                                                               monkeypatch):
+    """ADVICE r5 low: the batch endpoint's greedy+lookup path buffers all
+    rows (generate_batch_lookup) before the first data event — SSE
+    keepalive comment frames must flow WHILE it collects, so bytes reach
+    the client well before completion (no proxy/client idle timeout on
+    long generations)."""
+    import time as _time
+
+    from distributed_llama_tpu.apps import api_server
+
+    monkeypatch.setattr(api_server, "KEEPALIVE_SECS", 0.01)
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=2, lookup_decode=4)
+    from http.server import HTTPServer
+    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        req = {"prompts": ["abab", "ba"], "max_tokens": 6,
+               "temperature": 0, "stream": True}
+        conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first_byte_at = None
+        lines = []
+        while True:
+            line = resp.fp.readline()
+            if first_byte_at is None and line:
+                first_byte_at = _time.monotonic()
+            lines.append(line.decode())
+            if line.strip() == b"data: [DONE]":
+                done_at = _time.monotonic()
+                break
+            assert line, lines  # EOF before [DONE] = broken stream
+        # keepalive comments arrived, and BEFORE the first data event
+        # (the collected path yields no piece until the whole batch is
+        # done, so any earlier keepalive proves first-byte << completion)
+        first_data = next(i for i, ln in enumerate(lines)
+                          if ln.startswith("data: "))
+        keepalives = [i for i, ln in enumerate(lines)
+                      if ln.startswith(": keepalive")]
+        assert keepalives, lines
+        assert keepalives[0] < first_data, lines
+        assert first_byte_at < done_at
+        # the stream still ends with per-row finish chunks + [DONE]
+        datas = [json.loads(ln[len("data: "):]) for ln in lines
+                 if ln.startswith("data: ") and "[DONE]" not in ln]
+        finals = [d for d in datas if d["choices"][0]["finish_reason"]]
+        assert len(finals) == 2
+    finally:
+        server.shutdown()
+        state.engine.reset()
+
+
+def test_api_batch_lookup_stream_crash_yields_structured_error(tmp_path,
+                                                               rng,
+                                                               monkeypatch):
+    """An engine crash surfacing BEHIND the keepalives (after the 200/SSE
+    start) must follow the mid-stream error contract: an explicit
+    {"error": ...} event then [DONE] — never a dropped connection."""
+    import time as _time
+
+    from distributed_llama_tpu.apps import api_server
+
+    monkeypatch.setattr(api_server, "KEEPALIVE_SECS", 0.01)
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=2, lookup_decode=4)
+    sup = state.scheduler()  # build the supervisor, then wound its engine
+
+    def boom(*a, **k):
+        _time.sleep(0.05)  # long enough for a keepalive to have flowed
+        raise RuntimeError("injected lookup crash")
+
+    sup.engine.generate_batch_lookup = boom
+    from http.server import HTTPServer
+    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        req = {"prompts": ["abab", "ba"], "max_tokens": 6,
+               "temperature": 0, "stream": True}
+        conn.request("POST", "/v1/batch/completions", json.dumps(req),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200  # SSE already started when it crashed
+        raw = resp.read().decode()
+        datas = [ln[len("data: "):] for ln in raw.splitlines()
+                 if ln.startswith("data: ")]
+        assert datas[-1] == "[DONE]", raw
+        err_events = [json.loads(d) for d in datas[:-1]
+                      if "error" in json.loads(d)]
+        assert err_events and "injected lookup crash" in \
+            err_events[0]["error"], raw
+    finally:
+        server.shutdown()
+        if state._scheduler is not None:
+            state._scheduler.close()

@@ -159,6 +159,8 @@ def test_chaos_row_emits_valid_json():
         "BENCH_CHAOS_REQUESTS": "4",
         "BENCH_CHAOS_BATCH": "2",
         "BENCH_CHAOS_CRASHES": "1",
+        "BENCH_CLUSTER_REPEATS": "1",
+        "BENCH_CLUSTER_TIMEOUT": "1.5",
     }, timeout=560.0)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [line for line in r.stdout.strip().splitlines()
@@ -169,6 +171,17 @@ def test_chaos_row_emits_valid_json():
              if "chaos" in v["metric"]]
     assert len(chaos) == 1, row
     c = chaos[0]
+    # the cluster control-plane row rides the same BENCH_CHAOS flag:
+    # two-process worker-loss detection, bounded by --worker-timeout
+    cluster = [v for v in row.get("variants", [])
+               if "cluster_detect" in v["metric"]]
+    assert len(cluster) == 1, row
+    cl = cluster[0]
+    assert cl["unit"] == "ms" and cl["value"] > 0
+    assert cl["within_bound"] is True, cl
+    assert cl["value"] / 1e3 < cl["worker_timeout_s"], cl
+    assert cl["stall_reason"] == "timeout", cl
+    json.dumps(cl)  # machine-readable round-trip
     assert c["unit"] == "%" and 0.0 <= c["value"] <= 100.0
     assert c["requests"] == 4 and c["crashes_injected"] >= 1
     assert c["recoveries"] >= 1

@@ -1,0 +1,401 @@
+"""Two-process cluster chaos: bounded failure detection on the multihost
+control plane (parallel/multihost.py) under socket-level fault injection
+(runtime/faults.py conn_refused/recv_stall/frame_truncate/peer_close).
+
+These are REAL two-OS-process clusters driven by the
+parallel/cluster_harness.py subprocess CLI — but control-plane only (no
+model, no mesh, no jax.distributed, no compiles), so the whole suite rides
+the NON-SLOW tier and the CI `chaos` job. The contract under test is the
+one the reference ships broken (SURVEY §5.3 — a dead worker hangs the
+whole cluster forever):
+
+  * a worker that DIES mid-phase is detected within --worker-timeout and
+    produces a structured ClusterPeerLost diagnostic naming the node;
+  * a worker that WEDGES (recv_stall: socket open, reader stopped — the
+    shape no EOF will ever report) is detected by heartbeat silence;
+  * a TORN frame (frame_truncate) is detected as a protocol loss;
+  * a root killed with SIGKILL takes its workers down via bounded
+    detection, not coordinator-teardown luck;
+  * cluster formation retries refused connects with backoff and FAILS
+    STRUCTURED at --connect-timeout, and a protocol-version mismatch is a
+    symmetric formation error.
+
+No assertion in this file ever waits on an unbounded recv: every
+subprocess interaction carries a hard timeout well under the pytest
+default, and the detection-latency assertions are the acceptance bars
+(ISSUE 5) themselves.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS = "distributed_llama_tpu.parallel.cluster_harness"
+
+# detection bounds used across the suite: tight enough that a regression
+# to unbounded waits fails fast, loose enough for a loaded CI box
+HB = "0.15"
+TIMEOUT = 1.5      # --worker-timeout (seconds)
+SLACK = 6.0        # subprocess/communicate margin over the bound
+EXIT_PEER_LOST = 43
+EXIT_FORMATION = 44
+
+
+from distributed_llama_tpu.testing import free_port as _free_port
+
+
+def _spawn(role: str, port: int, *extra, faults: str = ""):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # the harness never inits a backend, but
+    env.pop("DLLAMA_FAULTS", None)  # never inherit ambient arming either
+    if faults:
+        env["DLLAMA_FAULTS"] = faults
+    args = [sys.executable, "-m", HARNESS, role, "--port", str(port),
+            "--heartbeat-interval", HB, "--worker-timeout", str(TIMEOUT),
+            *extra]
+    if role == "worker":
+        args += ["--rank", "1"]
+    return subprocess.Popen(args, cwd=REPO, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _events(out: str) -> list[dict]:
+    return [json.loads(ln) for ln in out.splitlines()
+            if ln.startswith("{")]
+
+
+def _event(events: list[dict], name: str) -> dict:
+    hits = [e for e in events if e["event"] == name]
+    assert hits, (name, events)
+    return hits[0]
+
+
+def _wait_event(proc, name: str, timeout: float) -> tuple[dict, list[str]]:
+    """Stream a harness process's stdout until the named event appears
+    (bounded). Returns (event, lines_consumed) — the consumed lines must
+    be recombined with communicate()'s remainder for full-event asserts."""
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    lines: list[str] = []
+    end = time.time() + timeout
+    try:
+        while time.time() < end:
+            if not sel.select(timeout=0.2):
+                continue
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line.startswith("{"):
+                ev = json.loads(line)
+                if ev["event"] == name:
+                    return ev, lines
+    finally:
+        sel.close()
+    proc.kill()
+    raise AssertionError(
+        f"event {name!r} never appeared within {timeout}s; got: {lines}")
+
+
+def _finish(proc, timeout: float):
+    """communicate() with a hard bound — a hung harness process is itself
+    the regression this suite exists to catch."""
+    try:
+        return proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate(timeout=10)
+        raise AssertionError(
+            f"harness process hung past {timeout}s (the unbounded-wait "
+            f"regression)\nstdout: {out}\nstderr: {err}")
+
+
+def test_formation_and_clean_shutdown():
+    """Happy path: HELLO handshake, heartbeats, phase ticks, SHUTDOWN —
+    both sides exit 0 with structured event streams."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,idle:0.4")
+    worker = _spawn("worker", port)
+    w_out, w_err = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert root.returncode == 0, (r_out, r_err)
+    assert worker.returncode == 0, (w_out, w_err)
+    r_ev, w_ev = _events(r_out), _events(w_out)
+    assert _event(r_ev, "formed")["peers"] == [1]
+    stats = _event(r_ev, "complete")["stats"]
+    assert stats["pings_sent"] >= 1 and stats["pongs_received"] >= 1
+    assert stats["peers_lost"] == []
+    assert _event(w_ev, "shutdown")["stats"]["pongs_sent"] >= 1
+    assert [e["phase"] for e in w_ev if e["event"] == "tick"] == [
+        "formation", "idle"]
+
+
+def test_worker_death_mid_prefill_detected():
+    """A worker dying abruptly mid-phase is detected within
+    --worker-timeout and the root's ClusterPeerLost names the node and
+    the phase it died in."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,prefill:20")
+    worker = _spawn("worker", port, "--die-after", "0.6")
+    w_out, _ = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert root.returncode == EXIT_PEER_LOST, (r_out, r_err)
+    lost = _event(_events(r_out), "cluster_peer_lost")
+    assert lost["node_id"] == 1
+    assert lost["phase"] == "prefill"
+    died = _event(_events(w_out), "dying")
+    detect_s = lost["t_wall"] - died["t_wall"]
+    # an abrupt process death closes the socket: detection is EOF-fast,
+    # far inside the heartbeat bound
+    assert 0 <= detect_s < TIMEOUT, (detect_s, lost)
+
+
+def test_worker_stall_mid_decode_detected():
+    """recv_stall wedges the worker's control-plane reader: the socket
+    stays OPEN (no EOF will ever fire) but PONGs stop — only the
+    heartbeat timeout can see it. Detection must land within
+    --worker-timeout of the last frame; before this control plane
+    existed, this exact shape hung the cluster forever (the reference's
+    unbounded socket read)."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,decode:30")
+    # after=2: let the HELLO_ACK recv and an early ping through, then
+    # wedge every subsequent recv (times=0)
+    worker = _spawn("worker", port, faults="recv_stall:after=2;times=0")
+    try:
+        r_out, r_err = _finish(root, TIMEOUT + 30)
+        assert root.returncode == EXIT_PEER_LOST, (r_out, r_err)
+        lost = _event(_events(r_out), "cluster_peer_lost")
+        assert lost["node_id"] == 1
+        assert lost["reason"] == "timeout"  # silence, not EOF
+        assert lost["phase"] == "decode"
+        # last_seen at detection ~= the timeout bound: the detector fired
+        # as soon as the contract allows, not after some larger slop
+        assert TIMEOUT <= lost["last_seen_s"] < TIMEOUT + 1.0, lost
+    finally:
+        worker.kill()  # the wedged reader never exits on its own
+        worker.communicate(timeout=10)
+
+
+def test_truncated_frame_detected():
+    """frame_truncate tears the worker's next PONG mid-frame and closes
+    the socket: the root must classify it as a protocol loss immediately
+    (no waiting out the heartbeat bound)."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,run:20")
+    # after=1: the HELLO send goes through, the first PONG tears
+    worker = _spawn("worker", port, faults="frame_truncate:after=1;times=1")
+    try:
+        r_out, r_err = _finish(root, 30)
+        assert root.returncode == EXIT_PEER_LOST, (r_out, r_err)
+        lost = _event(_events(r_out), "cluster_peer_lost")
+        assert lost["node_id"] == 1
+        # a torn write surfaces as a mid-frame EOF/reset at the reader
+        assert ("truncated" in lost["reason"] or lost["reason"]
+                in ("eof", "reset")), lost
+        assert lost["last_seen_s"] < TIMEOUT, lost  # no timeout wait
+    finally:
+        worker.kill()
+        worker.communicate(timeout=10)
+
+
+def test_root_sigkill_worker_exits():
+    """SIGKILL the root mid-phase: every worker must take its own bounded
+    diagnostic exit (EXIT_PEER_LOST, structured line naming node 0) —
+    the pre-change behavior parked workers in an unbounded read until
+    jax.distributed teardown happened to notice."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,decode:30")
+    worker = _spawn("worker", port)
+    _, pre_lines = _wait_event(worker, "formed", 60)  # cluster is up
+    t_kill = time.time()
+    root.send_signal(signal.SIGKILL)
+    root.communicate(timeout=10)
+    w_out, w_err = _finish(worker, TIMEOUT + SLACK)
+    assert worker.returncode == EXIT_PEER_LOST, (w_out, w_err)
+    lost = _event(_events("".join(pre_lines) + w_out), "cluster_peer_lost")
+    assert lost["node_id"] == 0
+    detect_s = lost["t_wall"] - t_kill
+    assert 0 <= detect_s < TIMEOUT + 1.0, (detect_s, lost)
+
+
+def test_connect_retry_backoff_then_success():
+    """conn_refused fails the first two connect attempts deterministically;
+    the worker's backoff loop must absorb them and still form."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,idle:0.3")
+    worker = _spawn("worker", port, faults="conn_refused:times=2")
+    w_out, w_err = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert worker.returncode == 0, (w_out, w_err)
+    assert root.returncode == 0, (r_out, r_err)
+    assert _event(_events(w_out), "formed")["retries"] >= 2
+
+
+def test_connect_timeout_is_bounded_and_structured():
+    """No root at all: the worker must give up at --connect-timeout with a
+    structured formation error (exit 44), never spin or hang."""
+    port = _free_port()  # nothing listens here
+    t0 = time.time()
+    worker = _spawn("worker", port, "--connect-timeout", "1.0")
+    w_out, w_err = _finish(worker, 20)
+    wall = time.time() - t0
+    assert worker.returncode == EXIT_FORMATION, (w_out, w_err)
+    failed = _event(_events(w_out), "formation_failed")
+    assert "--connect-timeout" in failed["error"]
+    assert wall < 1.0 + SLACK, wall
+
+
+def test_hello_version_mismatch_is_symmetric_error():
+    """A worker speaking the wrong protocol version must produce a clear
+    formation error on BOTH sides — never a half-formed cluster."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,idle:5",
+                  "--connect-timeout", "5")
+    worker = _spawn("worker", port, "--protocol-version", "99")
+    w_out, w_err = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert worker.returncode == EXIT_FORMATION, (w_out, w_err)
+    assert root.returncode == EXIT_FORMATION, (r_out, r_err)
+    for out in (w_out, r_out):
+        failed = _event(_events(out), "formation_failed")
+        assert "version" in failed["error"], failed
+
+
+# -- in-process shape/codec tests (no subprocess) --------------------------
+
+
+def test_cluster_peer_lost_shape():
+    from distributed_llama_tpu.parallel.multihost import ClusterPeerLost
+
+    exc = ClusterPeerLost(3, 2.5, "decode", "timeout")
+    assert exc.node_id == 3 and exc.phase == "decode"
+    s = exc.summary()
+    assert s == {"event": "cluster_peer_lost", "node_id": 3,
+                 "last_seen_s": 2.5, "phase": "decode",
+                 "reason": "timeout"}
+    assert "node 3" in str(exc) and "decode" in str(exc)
+
+
+def test_frame_codec_roundtrip_and_truncation():
+    from distributed_llama_tpu.parallel.multihost import (
+        _FRAME_HDR, _FRAME_MAGIC, ClusterProtocolError, _recv_frame,
+        _send_frame)
+
+    a, b = socket.socketpair()
+    try:
+        _send_frame(a, 7, [1, -2, 3], b"payload", timeout=5.0)
+        kind, ints, payload = _recv_frame(b, timeout=5.0)
+        assert (kind, ints, payload) == (7, [1, -2, 3], b"payload")
+
+        # torn frame: half the bytes then EOF -> structured protocol error
+        import struct
+        buf = _FRAME_HDR.pack(_FRAME_MAGIC, 7, 1, 0) + struct.pack("<q", 9)
+        a.sendall(buf[: len(buf) // 2])
+        a.close()
+        with pytest.raises(ClusterProtocolError, match="truncated"):
+            _recv_frame(b, timeout=5.0)
+    finally:
+        b.close()
+
+
+def test_frame_codec_rejects_garbage_magic():
+    from distributed_llama_tpu.parallel.multihost import (
+        ClusterProtocolError, _recv_frame)
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"GET / HTTP/1.1\r\n")  # a port scanner / wrong service
+        with pytest.raises(ClusterProtocolError, match="magic"):
+            _recv_frame(b, timeout=5.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_socket_fault_sites_registered():
+    """The chaos sites exist in the registry and parse from DLLAMA_FAULTS
+    (a typo'd site must fail loudly — faults.load_env contract)."""
+    from distributed_llama_tpu.runtime.faults import SITES, FaultRegistry
+
+    for site in ("conn_refused", "recv_stall", "frame_truncate",
+                 "peer_close"):
+        assert site in SITES
+    reg = FaultRegistry()
+    reg.load_env({"DLLAMA_FAULTS": "conn_refused:times=2,"
+                                   "recv_stall:after=2;times=0"})
+    assert reg.armed("conn_refused") and reg.armed("recv_stall")
+    with pytest.raises(ConnectionRefusedError):
+        reg.fire("conn_refused")
+    # triggered() consumes counts deterministically
+    reg.arm("peer_close", times=1)
+    assert reg.triggered("peer_close") is True
+    assert reg.triggered("peer_close") is False
+    reg.clear()
+
+
+def test_xfer_bench_header_carries_n_prompt():
+    """ADVICE r5 high, protocol side: send_xfer_bench(n_prompt) must
+    deliver n_prompt to the worker's RunMsg (max_tokens slot) so its
+    measure_prefill_transfer_ms(n_prompt) runs the identical collective
+    sequence as the root's (the collective half is pinned by the slow
+    two-process test_multihost.py::test_two_process_benchmark_completes)."""
+    import threading
+
+    from distributed_llama_tpu.parallel import multihost as mh
+
+    port = _free_port()
+    root = mh.RootLink(2, "", port, heartbeat_interval=0.2,
+                       worker_timeout=5.0, connect_timeout=5.0)
+    worker = mh.WorkerLink("127.0.0.1", port, 1, 2, connect_timeout=5.0)
+    t = threading.Thread(target=root.form)
+    t.start()
+    worker.form()
+    t.join(timeout=10)
+    old = mh.get_link()
+    try:
+        mh.set_link(root)
+        mh.send_xfer_bench(37)
+        mh.set_link(worker)
+        msg = mh.recv_msg(timeout=10.0)
+        assert msg.kind == mh.MSG_XFER_BENCH
+        assert msg.max_tokens == 37
+    finally:
+        mh.set_link(old)
+        root.close()
+        worker.close()
+
+
+def test_worker_recv_msg_wait_is_supervised():
+    """recv_msg's queue wait wakes on root loss and raises the structured
+    ClusterPeerLost — an idle worker can never block unboundedly."""
+    import threading
+
+    from distributed_llama_tpu.parallel import multihost as mh
+
+    port = _free_port()
+    root = mh.RootLink(2, "", port, heartbeat_interval=0.1,
+                       worker_timeout=1.0, connect_timeout=5.0)
+    worker = mh.WorkerLink("127.0.0.1", port, 1, 2, connect_timeout=5.0)
+    t = threading.Thread(target=root.form)
+    t.start()
+    worker.form()
+    t.join(timeout=10)
+    try:
+        t0 = time.time()
+        root.close()  # root goes away while the worker waits for a frame
+        with pytest.raises(mh.ClusterPeerLost) as ei:
+            worker.recv(timeout=30.0)
+        assert ei.value.node_id == 0
+        assert time.time() - t0 < 5.0  # EOF-fast, nowhere near 30s
+    finally:
+        worker.close()

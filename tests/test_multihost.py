@@ -9,7 +9,6 @@ greedy transcript must equal a single-process run of the same model.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -45,9 +44,9 @@ def _run(cli_args, n_local_devices=1, timeout=600):
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from distributed_llama_tpu.testing import free_port
+
+    return free_port()
 
 
 def _gen_line(out: str) -> str:
@@ -241,6 +240,34 @@ def test_two_process_cluster_api_mode(tmp_path, lookup):
         _, w_err = _stop(worker)
         print("root stderr:", r_err[-2000:])    # shown on failure
         print("worker stderr:", w_err[-2000:])
+
+
+def test_two_process_benchmark_completes(tmp_path):
+    """ADVICE r5 HIGH regression: `inference` (--benchmark) over a
+    2-process cluster must COMPLETE. The root's _print_benchmark runs
+    measure_transfer_ms AND measure_prefill_transfer_ms(n_prompt) —
+    real collectives over the global mesh — so the MSG_XFER_BENCH header
+    now carries n_prompt and workers run the IDENTICAL sequence; before
+    the fix the root's prefill microbench had no worker counterpart and
+    the cluster deadlocked here (this test timed out)."""
+    mpath, tpath = _fixture(tmp_path)
+    base = ["--model", mpath, "--tokenizer", tpath, "--prompt", "ab",
+            "--steps", "4", "--seed", "7", "--temperature", "0",
+            "--buffer-float-type", "f32"]
+    port = _free_port()
+    cluster = ["--nnodes", "2", "--coordinator", f"127.0.0.1:{port}"]
+    root, t = _run(["inference", *base, *cluster, "--node-rank", "0"])
+    worker, _ = _run(["worker", "--model", mpath, "--tokenizer", tpath,
+                      "--temperature", "0", "--buffer-float-type", "f32",
+                      *cluster, "--node-rank", "1"])
+    out_root, err_root = root.communicate(timeout=t)
+    out_worker, err_worker = worker.communicate(timeout=t)
+    assert root.returncode == 0, (out_root, err_root)
+    assert worker.returncode == 0, (out_worker, err_worker)
+    # the benchmark epilogue only prints after BOTH microbenches complete
+    assert "Avg tokens / second:" in out_root, out_root
+    assert "Avg transfer" in out_root, out_root
+    assert "root shut down" in out_worker
 
 
 def test_worker_mode_requires_cluster_flags():

@@ -482,3 +482,47 @@ def test_exclusive_borrow_crash_triggers_recovery(tiny):
             spec, params, [1, 9, 23], 4)
     finally:
         sup.close()
+
+
+def test_trip_cluster_opens_breaker_with_structured_frames(tiny):
+    """EngineSupervisor.trip_cluster (the api-mode mapping of a multihost
+    ClusterPeerLost): in-flight requests get a NON-retryable
+    cluster_peer_lost frame immediately — never a hang to their deadline
+    in an orphaned collective — and the circuit opens without burning
+    rebuild attempts (a local rebuild cannot resurrect a remote worker).
+    reset_breaker() stays the operator's half-open."""
+    from distributed_llama_tpu.parallel.multihost import ClusterPeerLost
+
+    spec, params = tiny
+    sup = EngineSupervisor(_factory(tiny), chunk=8, stall_timeout=60.0,
+                           backoff_base=0.01)
+    try:
+        req = sup.submit([1, 2, 3], 48, _greedy(spec))
+        assert _wait(lambda: req.stats.t_first is not None, 30.0)
+        recoveries_before = sup.sup_stats.recoveries
+        sup.trip_cluster(ClusterPeerLost(2, 10.1, "run", "timeout"))
+        assert sup.state == BROKEN
+        assert sup.sup_stats.cluster_losses == 1
+        with pytest.raises(RequestError) as ei:
+            list(req.tokens(timeout=10.0))
+        assert ei.value.code == "cluster_peer_lost"
+        assert ei.value.retryable is False
+        assert "node 2" in str(ei.value)
+        # no rebuild was attempted: BROKEN means operator intervention
+        time.sleep(0.2)
+        assert sup.sup_stats.recoveries == recoveries_before
+        # idempotent: a second detection does not double-count
+        sup.trip_cluster(ClusterPeerLost(2, 11.0, "run", "timeout"))
+        assert sup.sup_stats.cluster_losses == 1
+        # admission while broken is a structured fast rejection
+        with pytest.raises(EngineUnready):
+            sup.submit([1, 2, 3], 4, _greedy(spec))
+        # operator half-open: the replica recovers once reset
+        sup.reset_breaker()
+        assert _wait(lambda: sup.ready, 60.0), sup.state
+        req2 = sup.submit([1, 9, 23], 4, _greedy(spec))
+        assert list(req2.tokens(timeout=60.0)) == _oracle(
+            spec, params, [1, 9, 23], 4)
+        assert sup.summary()["resilience"]["cluster_losses"] == 1
+    finally:
+        sup.close()
