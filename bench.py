@@ -39,7 +39,11 @@ the continuous-batching Poisson-arrival serving row (_serve_row;
 BENCH_SERVE_REQUESTS/_BATCH/_BUDGETS size the trace), BENCH_PREFIX=1 to
 add the radix prefix-cache shared-system-prompt row (_prefix_row;
 BENCH_PREFIX_REQUESTS/_BATCH/_SYS/_BLOCK/_TOKENS size it), BENCH_CHAOS=1
-to add the fault-injection resilience row (_chaos_row).
+to add the fault-injection resilience row (_chaos_row), BENCH_ROUTER=1 to
+add the 2-replica failover-router row (_router_row; cache-aware vs
+round-robin placement + one injected replica kill —
+BENCH_ROUTER_REQUESTS/_BATCH/_GROUPS/_SYS/_BLOCK/_BLOCKS/_TOKENS/
+_KILL_AFTER size it).
 """
 
 from __future__ import annotations
@@ -869,6 +873,204 @@ def _chaos_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
     }
 
 
+def _router_row(params, spec: ModelSpec, prefix: str, b: int = 2) -> dict:
+    """Multi-replica serving tier (the ISSUE-6 metric): a shared-prefix
+    Poisson trace — prompts drawn from BENCH_ROUTER_GROUPS distinct
+    system-prompt families — served by TWO replicas twice:
+
+      * ROUND_ROBIN — the "2x independent servers" regime: requests
+        alternate replicas blindly, so every prefix family must warm on
+        BOTH replicas before it ever hits;
+      * CACHE_AWARE — the router's SGLang-style placement: each family
+        concentrates on the replica whose radix tree already holds it,
+        so a family pays exactly ONE cold prefill tier-wide.
+
+    The placement A/B runs CLOSED-LOOP (one request in flight at a time):
+    with a fixed seed the placement sequence — and therefore the
+    hit/miss count — is fully DETERMINISTIC, so the reported gap
+    measures the policy, never CPU timing luck. The chaos pass then
+    re-serves the trace OPEN-LOOP (Poisson arrivals, work genuinely in
+    flight) on cache_aware with ONE replica killed mid-trace
+    (replica_raise, count-deterministic) to measure what clients
+    experience during the failure: availability % (router readiness at
+    5 ms), ZERO failed not-yet-streamed requests (retried on the
+    survivor), structured frames for mid-stream ones, and greedy token
+    parity with the crash-free runs.
+
+    Env knobs: BENCH_ROUTER_REQUESTS (default 16), BENCH_ROUTER_BATCH
+    (per-replica slots, default 2), BENCH_ROUTER_GROUPS (default 4),
+    BENCH_ROUTER_SYS (shared tokens per family, default 48),
+    BENCH_ROUTER_BLOCK (block_len, default 16), BENCH_ROUTER_BLOCKS
+    (arena blocks per replica, default ample for every family),
+    BENCH_ROUTER_TOKENS (decode budget, default 8),
+    BENCH_ROUTER_KILL_AFTER (replica 0 steps before the kill, default 5).
+    """
+    import gc
+    import threading
+    import time
+
+    from distributed_llama_tpu.runtime.faults import FAULTS
+    from distributed_llama_tpu.runtime.router import Router
+    from distributed_llama_tpu.runtime.scheduler import RequestError
+    from distributed_llama_tpu.sampler import Sampler
+
+    b = int(os.environ.get("BENCH_ROUTER_BATCH", str(b)))
+    n_req = max(int(os.environ.get("BENCH_ROUTER_REQUESTS", "16")), 4)
+    groups = max(int(os.environ.get("BENCH_ROUTER_GROUPS", "4")), 1)
+    sys_len = int(os.environ.get("BENCH_ROUTER_SYS", "48"))
+    bl = int(os.environ.get("BENCH_ROUTER_BLOCK", "16"))
+    budget = int(os.environ.get("BENCH_ROUTER_TOKENS", "8"))
+    kill_after = int(os.environ.get("BENCH_ROUTER_KILL_AFTER", "5"))
+    blocks = int(os.environ.get(
+        "BENCH_ROUTER_BLOCKS",
+        str(2 * groups * (sys_len // bl + 1) + 8)))
+    seq = min(512, spec.seq_len)
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+    rng = np.random.default_rng(0)
+    families = [rng.integers(1, spec.vocab_size, sys_len).astype(
+        np.int64).tolist() for _ in range(groups)]
+    gidx = rng.integers(0, groups, n_req)
+    tails = [rng.integers(1, spec.vocab_size, (8, 12, 16)[i % 3]).astype(
+        np.int64).tolist() for i in range(n_req)]
+    prompts = [families[int(gidx[i])] + tails[i] for i in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(0.04, n_req))
+
+    def factory():
+        return Engine(spec, params, compute_dtype=cdt, cache_dtype=cdt,
+                      max_seq_len=seq, batch=b)
+
+    def greedy():
+        return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=7)
+
+    def run_trace(policy: str, kill: bool, closed_loop: bool) -> dict:
+        FAULTS.clear()
+        router = Router(factory, replicas=2, policy=policy, retry_budget=1,
+                        chunk=bl, stall_timeout=60.0, backoff_base=0.05,
+                        breaker_threshold=10_000, circuit_threshold=10_000,
+                        prefix_blocks=blocks, prefix_block_len=bl)
+        outs: dict = {}
+        errs: dict = {}
+        ready_samples: list = []
+        sampling = threading.Event()
+        sampling.set()
+
+        def sample_ready():
+            while sampling.is_set():
+                ready_samples.append(router.ready)
+                time.sleep(0.005)
+
+        def client(i):
+            got: list = []
+            try:
+                req = router.submit(prompts[i], budget, greedy())
+                for t in req.tokens(timeout=300.0):
+                    got.append(t)
+                outs[i] = (got, req.retries)
+            except RequestError as e:
+                errs[i] = (len(got), e)
+            except Exception as e:  # noqa: BLE001 — no-replica rejection
+                errs[i] = (len(got), e)
+
+        if kill:
+            FAULTS.arm("replica_raise", key="r0", after=kill_after)
+        samp = threading.Thread(target=sample_ready, daemon=True)
+        samp.start()
+        threads = []
+        t0 = time.perf_counter()
+        try:
+            for i in range(n_req):
+                if closed_loop:
+                    # placement A/B: one request at a time — with both
+                    # replicas idle at every pick, the placement (and so
+                    # the hit count) is a pure, deterministic function
+                    # of the policy
+                    client(i)
+                    continue
+                dt = t0 + arrivals[i] - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                t = threading.Thread(target=client, args=(i,), daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=300.0)
+        finally:
+            sampling.clear()
+            FAULTS.clear()
+        wall = time.perf_counter() - t0
+        samp.join(timeout=2.0)
+        # prefix-cache counters across EVERY generation of both replicas
+        # (a killed replica's pre-crash stats live in its supervisor's
+        # dead-generation list, not the rebuilt tree's fresh zeros)
+        all_stats = []
+        for h in router.replicas:
+            all_stats.append(h.sup.stats)
+            all_stats.extend(h.sup._dead_stats)
+        lookups = sum(s.prefix.lookups for s in all_stats if s.prefix)
+        hits = sum(s.prefix.hits for s in all_stats if s.prefix)
+        saved = sum(s.prefix.tokens_saved for s in all_stats if s.prefix)
+        prefilled = sum(s.prefix.tokens_prefilled for s in all_stats
+                        if s.prefix)
+        summary = router.summary()
+        crashes = sum(r["resilience"]["crashes"]
+                      for r in summary["replicas"])
+        out = {
+            "hit_rate_pct": round(100.0 * hits / lookups, 2) if lookups
+            else 0.0,
+            "prefill_saved_pct": round(
+                100.0 * saved / (saved + prefilled), 2)
+            if saved + prefilled else 0.0,
+            "agg_tok_per_s": round(
+                sum(len(o) for o, _ in outs.values()) / wall, 1),
+            "ttft_p50_ms": summary["ttft_p50_ms"],
+            "availability_pct": round(
+                100.0 * sum(ready_samples) / len(ready_samples), 2)
+            if ready_samples else None,
+            "completed": len(outs),
+            "unstreamed_failures": sum(1 for n, _ in errs.values()
+                                       if n == 0),
+            "midstream_failures": sum(1 for n, _ in errs.values()
+                                      if n > 0),
+            "retries": router.stats.retries,
+            "failovers_ok": router.stats.failovers_ok,
+            "crashes_injected": crashes,
+            "outs": {i: o for i, (o, _) in outs.items()},
+        }
+        router.close()
+        del router
+        gc.collect()
+        return out
+
+    # three serves of the SAME trace: the placement A/B runs crash-free
+    # (the hit-rate gap must measure the POLICY, not which run ate the
+    # kill), then the chaos pass re-runs cache-aware with one replica
+    # killed mid-trace for the availability/failover numbers
+    rr = run_trace("round_robin", kill=False, closed_loop=True)
+    ca = run_trace("cache_aware", kill=False, closed_loop=True)
+    chaos = run_trace("cache_aware", kill=True, closed_loop=False)
+    # greedy parity: every request COMPLETED in a run must match the
+    # round-robin run token-for-token (failover replays are
+    # bit-identical; mid-stream kills errored structurally and are
+    # excluded by construction)
+    parity = all(run["outs"][i] == rr["outs"][i]
+                 for run in (ca, chaos) for i in run["outs"]
+                 if i in rr["outs"])
+    for run in (rr, ca, chaos):
+        run.pop("outs")
+    return {
+        "metric": f"{prefix}_router_2rep_cache_aware_hit_rate_pct",
+        "value": ca["hit_rate_pct"], "unit": "%", "vs_baseline": None,
+        "requests": n_req, "replicas": 2, "batch_per_replica": b,
+        "prefix_families": groups, "family_tokens": sys_len,
+        "block_len": bl, "arena_blocks_per_replica": blocks,
+        "token_parity": parity,
+        "round_robin": rr, "cache_aware": ca, "cache_aware_chaos": chaos,
+        "hit_rate_gain_pct": round(
+            ca["hit_rate_pct"] - rr["hit_rate_pct"], 2),
+    }
+
+
 def _cluster_chaos_row(prefix: str) -> dict:
     """Cluster worker-loss detection latency (the ISSUE-5 metric): spawn
     REAL two-OS-process control-plane clusters (parallel/cluster_harness
@@ -1173,6 +1375,14 @@ def main() -> None:
             # shared-system-prompt trace served cache OFF vs ON —
             # prefill tokens saved %, TTFT delta, greedy token parity
             emit(_prefix_row(params, spec,
+                             prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_ROUTER", "0") != "0":
+            # multi-replica router row (runtime/router.py): the shared-
+            # prefix trace at 2 replicas, cache-aware vs round-robin
+            # placement, with one replica killed mid-trace — hit-rate
+            # gain, availability %, zero-unstreamed-failure count
+            emit(_router_row(params, spec,
                              prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_CHAOS", "0") != "0":

@@ -31,6 +31,7 @@ reference's behavior, minus dropped connections).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 import threading
@@ -61,6 +62,17 @@ class BadRequest(ValueError):
     request forever."""
 
 
+def _is_loopback(addr: str) -> bool:
+    """Default guard for the /admin/* operator endpoints: auth-free but
+    loopback-only — an operator SSHed onto the box (or a sidecar) can
+    reset breakers and roll replicas, while nothing routable from the
+    service port's clients can. Covers IPv4 loopback (the whole
+    127.0.0.0/8), IPv6 ::1, and the IPv6-mapped IPv4 form."""
+    if addr.startswith("::ffff:"):
+        addr = addr[len("::ffff:"):]
+    return addr == "::1" or addr.startswith("127.")
+
+
 def build_chat_prompt(messages: list[dict]) -> str:
     """Llama-3 header template (ref: dllama-api.cpp:173-181)."""
     out = []
@@ -77,7 +89,8 @@ class ApiState:
                  serve_chunk: int = 0, queue_depth: int = 0,
                  request_deadline: float = 0.0, stall_timeout: float = 0.0,
                  prefix_cache: bool = False, prefix_blocks: int = 0,
-                 prefix_block_len: int = 32):
+                 prefix_block_len: int = 32, replicas: int = 1,
+                 retry_budget: int = 1, route_policy: str = "cache_aware"):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -112,6 +125,14 @@ class ApiState:
         self.prefix_cache = prefix_cache
         self.prefix_block_len = prefix_block_len
         self.prefix_blocks = prefix_blocks
+        # multi-replica serving tier (runtime/router.py): replicas > 1
+        # puts a cache-aware failover router in front of N supervised
+        # engine replicas over SHARED weights; retry_budget bounds the
+        # automatic resubmits of not-yet-streamed requests after a
+        # replica failure, route_policy picks the placement rule
+        self.replicas = replicas
+        self.retry_budget = retry_budget
+        self.route_policy = route_policy
         # serializes legacy single-engine requests under the threaded
         # accept loop (the scheduler path needs no lock — it queues)
         self.engine_lock = threading.RLock()
@@ -122,45 +143,33 @@ class ApiState:
         self.cluster_lost = None
 
     def scheduler(self):
-        """The SUPERVISED continuous-batching front door
-        (runtime/resilience.EngineSupervisor over runtime/scheduler.py),
-        built and started on first use. Its batch=serve_batch engine
-        SHARES the single engine's param device buffers (weights are never
-        duplicated) and owns THE ONLY live batched KV cache in the
-        process: the legacy batch endpoint borrows the same engine via
-        Scheduler.exclusive() instead of allocating a second one. The
-        supervisor's engine_factory builds the same engine again on crash
-        recovery — weights still shared, only the KV cache and jit
-        wrappers are new. Single-device only — serve() refuses
-        --serve-batch on meshes/clusters at startup."""
+        """The serving front door, built and started on first use: an
+        ``EngineSupervisor`` (replicas == 1) or a failover ``Router``
+        over N supervised replicas — both constructed by
+        runtime/router.build_front_door, the engine-owner logic that
+        used to live here (the HTTP layer no longer builds engines). The
+        handlers speak one duck-typed surface (``submit``/``engine``/
+        ``exclusive``/``ready``/``summary``), so 1 and N replicas serve
+        through identical code. Every replica's engine SHARES this
+        engine's param device buffers — replication costs KV caches and
+        prefix arenas, never weight copies. Single-device only —
+        serve() refuses --serve-batch on meshes/clusters at startup."""
         with self.engine_lock:  # two first requests must not double-build
             if self._scheduler is None:
-                from ..runtime.engine import Engine
-                from ..runtime.resilience import EngineSupervisor
+                from ..runtime.router import build_front_door
 
-                e = self.engine
-
-                def engine_factory():
-                    return Engine(
-                        e.spec, e.params, batch=self.serve_batch,
-                        max_seq_len=e.seq_len, compute_dtype=e.compute_dtype,
-                        cache_dtype=e.cache_dtype, use_pallas=e.use_pallas,
-                        pallas_interpret=e.pallas_interpret,
-                        activation_q80=e.activation_q80,
-                        prefill_chunk=e.prefill_chunk)
-
-                n_blocks = 0
-                if self.prefix_cache:
-                    bl = self.prefix_block_len
-                    n_blocks = self.prefix_blocks or max(
-                        2 * self.serve_batch * e.seq_len // bl, 1)
-                self._scheduler = EngineSupervisor(
-                    engine_factory, chunk=self.serve_chunk or None,
-                    max_queue=self.queue_depth or 4 * self.serve_batch,
-                    request_deadline=self.request_deadline or None,
-                    stall_timeout=self.stall_timeout or 10.0,
-                    prefix_blocks=n_blocks,
-                    prefix_block_len=self.prefix_block_len)
+                self._scheduler = build_front_door(
+                    self.engine, serve_batch=self.serve_batch,
+                    serve_chunk=self.serve_chunk,
+                    queue_depth=self.queue_depth,
+                    request_deadline=self.request_deadline,
+                    stall_timeout=self.stall_timeout,
+                    prefix_cache=self.prefix_cache,
+                    prefix_blocks=self.prefix_blocks,
+                    prefix_block_len=self.prefix_block_len,
+                    replicas=self.replicas,
+                    retry_budget=self.retry_budget,
+                    route_policy=self.route_policy)
             return self._scheduler
 
     def batch_engine(self):
@@ -385,7 +394,16 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
     n_gen = min(max_tokens, limit) if max_tokens > 0 else limit
     # PromptTooLong raises HERE (before any event) — the handler still
     # turns it into a clean 400 through the queued/threaded path
-    req = sched.submit(tokens, n_gen, sampler, eos_id=tokenizer.eos_id)
+    kwargs = {}
+    if state.replicas > 1:
+        # multi-replica tier: the OpenAI `user` field (or an explicit
+        # `session`) keys replica stickiness, so a conversation keeps
+        # hitting the replica whose radix tree caches its history
+        session = body.get("session") or body.get("user")
+        if session is not None:
+            kwargs["session"] = str(session)
+    req = sched.submit(tokens, n_gen, sampler, eos_id=tokenizer.eos_id,
+                       **kwargs)
 
     scan = _piece_scanner(tokenizer, tokens[-1], markers, stops)
     emitted = 0
@@ -793,15 +811,34 @@ def make_handler(state: ApiState):
                 self._json(200, {"status": "ready", "scheduler": "idle"})
             else:
                 sup = state._scheduler
+                payload = {"state": sup.state}
+                if state.replicas > 1:
+                    # multi-replica tier: readiness is ANY-replica (one
+                    # failure must not unready the service); the per-
+                    # replica states ride along for the operator
+                    # suffix the ROUTER-level conditions the supervisor
+                    # state can't see — a replica can be supervisor-ready
+                    # yet unrouted (drained or circuit open), and the
+                    # operator needs to see WHY from the probe body
+                    payload["replicas"] = {
+                        f"r{h.id}": (h.state
+                                     + ("/draining" if h.draining else "")
+                                     + ("/breaker_open"
+                                        if h.open_until > 0.0 else ""))
+                        for h in sup.replicas}
                 if sup.ready:
-                    self._json(200, {"status": "ready",
-                                     "state": sup.state})
+                    self._json(200, {"status": "ready", **payload})
                 else:
-                    self._json(503, {"status": "unready",
-                                     "state": sup.state},
+                    self._json(503, {"status": "unready", **payload},
                                retry_after=sup._retry_after())
 
         def do_POST(self):
+            if self.path.startswith("/admin/"):
+                # operator surface: dispatched BEFORE the draining check —
+                # an operator must be able to reset a breaker or undrain
+                # a replica while the front door refuses client traffic
+                self._admin_post()
+                return
             if self.path not in ("/v1/chat/completions", "/v1/completions",
                                  "/v1/batch/completions"):
                 self._json(404, {"error": "not found"})
@@ -823,6 +860,84 @@ def make_handler(state: ApiState):
             else:
                 self._chat_post(body,
                                 chat=self.path == "/v1/chat/completions")
+
+        def _admin_post(self) -> None:
+            """Operator endpoints (docs/operations.md "Multi-replica
+            operations"): loopback-guarded (403 otherwise), never
+            404-dependent on launch flags once --serve-batch is on.
+
+              POST /admin/reset_breaker   {replica?: i}  — operator
+                   half-open for the engine breaker (BROKEN state) and
+                   the router circuit; omitting `replica` resets ALL.
+                   This is the HTTP face of reset_breaker(): before it,
+                   a BROKEN supervisor in api mode was an outage only a
+                   Python REPL could end.
+              POST /admin/drain_replica   {replica: i, timeout?: s}
+              POST /admin/restart_replica {replica: i, timeout?: s}
+              POST /admin/undrain_replica {replica: i}
+                   — the rolling-restart recipe, one replica at a time
+                   (multi-replica servers only)."""
+            if not _is_loopback(self.client_address[0]):
+                self._json(403, {"error": "admin endpoints are "
+                                          "loopback-only by default"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                replica = body.get("replica")
+                if replica is not None:
+                    replica = int(replica)
+                timeout = float(body.get("timeout", 30.0))
+            except (ValueError, TypeError, json.JSONDecodeError):
+                self._json(400, {"error": "bad request"})
+                return
+            if state.serve_batch <= 0:
+                self._json(404, {"error": "no supervised scheduler "
+                                          "(start with --serve-batch N)"})
+                return
+            sup = state._scheduler
+            if sup is None:
+                # nothing built yet — nothing to reset or drain; answer
+                # idempotently rather than building the engine stack
+                # from an admin poke
+                self._json(200, {"status": "idle"})
+                return
+            from ..runtime.router import Router
+            is_router = isinstance(sup, Router)
+            if replica is not None and not (
+                    is_router and 0 <= replica < len(sup.replicas)):
+                self._json(400, {"error": f"no replica {replica} "
+                                 "(--replicas "
+                                 f"{state.replicas if is_router else 1})"})
+                return
+            if self.path == "/admin/reset_breaker":
+                if is_router:
+                    sup.reset_breaker(replica)
+                else:
+                    sup.reset_breaker()
+                self._json(200, {"status": "ok", "state": sup.state})
+            elif self.path in ("/admin/drain_replica",
+                               "/admin/restart_replica",
+                               "/admin/undrain_replica"):
+                if not is_router or replica is None:
+                    self._json(400, {"error": "replica operations need "
+                                              "--replicas N > 1 and a "
+                                              "replica index"})
+                    return
+                if self.path == "/admin/drain_replica":
+                    ok = sup.drain_replica(replica, timeout=timeout)
+                    self._json(200, {"status": "drained" if ok
+                                     else "drain_timeout",
+                                     "replica": replica})
+                elif self.path == "/admin/restart_replica":
+                    sup.restart_replica(replica, timeout=timeout)
+                    self._json(200, {"status": "restarted",
+                                     "replica": replica})
+                else:
+                    sup.undrain_replica(replica)
+                    self._json(200, {"status": "ok", "replica": replica})
+            else:
+                self._json(404, {"error": "not found"})
 
         def _batch_post(self, body: dict) -> None:
             """POST /v1/batch/completions — up to serve_batch prompts in one
@@ -925,9 +1040,14 @@ def make_handler(state: ApiState):
 
             multihost = jax.process_count() > 1
             use_sched = state.serve_batch > 0 and not multihost
-            if not use_sched:
-                state.engine_lock.acquire()
-            try:
+            # legacy single-engine path: serialize under the engine lock,
+            # CONTEXT-MANAGED — the old bare acquire()/release() pair
+            # could leave the lock held forever if anything raised
+            # between the acquire and the try that released it, wedging
+            # every later legacy request behind a dead handler thread
+            lock = (contextlib.nullcontext() if use_sched
+                    else state.engine_lock)
+            with lock:
                 if multihost:
                     # multi-host cluster: workers replay this exact request
                     # from the raw body (apps/dllama.py cmd_worker);
@@ -1037,9 +1157,6 @@ def make_handler(state: ApiState):
                         rid, created, state.model_name, text,
                         usage["finish_reason"], usage["prompt_tokens"],
                         usage["completion_tokens"]))
-            finally:
-                if not use_sched:
-                    state.engine_lock.release()
 
     return Handler
 
@@ -1085,6 +1202,26 @@ def serve(args) -> None:
         # is caught, and changing the default cannot break this check)
         sys.exit("error: --prefix-blocks/--prefix-block-len have no "
                  "effect without --prefix-cache")
+    replicas = getattr(args, "replicas", None)
+    replicas = 1 if replicas is None else replicas
+    if replicas < 1:
+        # explicit `--replicas 0` must hit this, not coerce to 1
+        sys.exit("error: --replicas must be >= 1")
+    if not serve_batch and (
+            replicas > 1
+            or getattr(args, "retry_budget", None) is not None
+            or getattr(args, "route_policy", None) is not None):
+        # the router fronts N slot schedulers — without --serve-batch
+        # these flags would be silently dead configuration (retry-budget
+        # and route-policy use None sentinels so even an explicit
+        # default value is caught)
+        sys.exit("error: --replicas/--retry-budget/--route-policy "
+                 "require --serve-batch N (the failover router fronts "
+                 "the continuous-batching scheduler)")
+    if replicas == 1 and (getattr(args, "retry_budget", None) is not None
+                          or getattr(args, "route_policy", None) is not None):
+        sys.exit("error: --retry-budget/--route-policy have no effect "
+                 "without --replicas N > 1")
 
     engine, tokenizer, sampler = build_engine(args)
     prefix_block_len = getattr(args, "prefix_block_len", None) or 32
@@ -1108,7 +1245,12 @@ def serve(args) -> None:
                      stall_timeout=getattr(args, "stall_timeout", 0.0),
                      prefix_cache=getattr(args, "prefix_cache", False),
                      prefix_blocks=getattr(args, "prefix_blocks", 0),
-                     prefix_block_len=prefix_block_len)
+                     prefix_block_len=prefix_block_len,
+                     replicas=replicas,
+                     retry_budget=(1 if getattr(args, "retry_budget", None)
+                                   is None else args.retry_budget),
+                     route_policy=(getattr(args, "route_policy", None)
+                                   or "cache_aware"))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "

@@ -204,6 +204,35 @@ def build_argparser() -> argparse.ArgumentParser:
                         "admissions stop immediately, in-flight requests "
                         "get this long to finish before being failed "
                         "with structured shutdown frames")
+    # multi-replica serving-tier flags (api mode; runtime/router.py,
+    # docs/operations.md "Multi-replica operations")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="api mode, with --serve-batch: run N supervised "
+                        "engine replicas behind a cache-aware failover "
+                        "router (runtime/router.py) — weights SHARED, "
+                        "each replica its own KV cache + prefix arena. "
+                        "A crashed/stalled/broken replica is invisible "
+                        "to clients: not-yet-streamed requests retry on "
+                        "a healthy sibling (token-identical for greedy), "
+                        "/readyz stays ready while any replica serves, "
+                        "and replicas drain/restart one at a time "
+                        "(POST /admin/drain_replica) with zero failed "
+                        "requests")
+    p.add_argument("--retry-budget", type=int, default=None, metavar="K",
+                   help="api mode, with --replicas: automatic failover "
+                        "resubmits per request (default 1). Only "
+                        "requests that have not streamed a token are "
+                        "retried; mid-stream failures surface a "
+                        "structured non-retryable error frame instead")
+    p.add_argument("--route-policy", default=None,
+                   choices=["cache_aware", "least_loaded", "round_robin"],
+                   help="api mode, with --replicas: placement policy "
+                        "(default cache_aware — route to the replica "
+                        "whose radix tree caches the longest prompt "
+                        "prefix, fall back to least-loaded; the SGLang "
+                        "cache-aware routing idea). Session affinity "
+                        "(body `session`/`user` field) applies under "
+                        "every policy")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,

@@ -21,6 +21,18 @@ invariant under injection):
   * ``slow_step``     — scheduler step loop: sleeps ``ms`` per fire (the
                         degraded-but-alive shape deadlines must catch)
 
+Replica-level sites, fired in the scheduler step loop of schedulers that
+carry a ``fault_key`` (the router's replicas — runtime/router.py names
+replica i's scheduler ``r{i}``), so multi-replica chaos tests can kill or
+wedge ONE replica deterministically while its siblings keep serving:
+
+  * ``replica_raise`` — like ``step_raise``, but an armed ``key=rK`` spec
+                        only counts and fires on replica K's steps (the
+                        kill-one-mid-trace shape: the router must retry
+                        not-yet-streamed requests on a survivor)
+  * ``replica_stall`` — like ``step_stall`` with the same key filter (one
+                        replica wedges; only ITS watchdog may trip)
+
 Socket-layer sites, fired inside the multihost control-plane frame codec
 (parallel/multihost.py) so two-process chaos tests can kill or stall either
 side of the root<->worker star and assert bounded detection
@@ -46,7 +58,10 @@ harnesses (bench chaos rows, CI):
     DLLAMA_FAULTS="step_raise:after=40;times=1,slow_step:ms=50;times=0"
 
 ``after=N`` skips the first N invocations of the site, ``times=K`` fires on
-the next K (K=0 → every invocation), ``ms=F`` sets the stall/sleep length.
+the next K (K=0 → every invocation), ``ms=F`` sets the stall/sleep length,
+``key=S`` restricts a replica-level site to the scheduler whose
+``fault_key`` is S (invocations from other keys are not even counted, so
+``after`` stays deterministic per replica).
 Counters are per-site and monotonically increasing, so a given arm spec
 fires at exactly the same invocations on every run — crashes land on the
 same scheduler iteration every time.
@@ -59,6 +74,7 @@ import os
 import threading
 
 SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
+         "replica_raise", "replica_stall",
          "conn_refused", "recv_stall", "frame_truncate", "peer_close")
 
 
@@ -73,6 +89,8 @@ class _Armed:
     after: int = 0     # skip this many invocations of the site first
     times: int = 1     # then fire on this many (0 = every one from there on)
     ms: float = 0.0    # stall/sleep milliseconds (step_stall / slow_step)
+    key: str | None = None  # replica filter: only fire() calls carrying
+    # this key count or fire (None = any caller)
     hits: int = 0      # invocations seen
     fired: int = 0     # invocations that actually fired
 
@@ -99,12 +117,13 @@ class FaultRegistry:
         self._release = threading.Event()
 
     def arm(self, site: str, *, after: int = 0, times: int = 1,
-            ms: float = 0.0) -> None:
+            ms: float = 0.0, key: str | None = None) -> None:
         if site not in SITES:
             raise ValueError(f"unknown fault site {site!r} (have {SITES})")
         with self._lock:
             self._release.clear()
-            self._armed[site] = _Armed(site, after=after, times=times, ms=ms)
+            self._armed[site] = _Armed(site, after=after, times=times, ms=ms,
+                                       key=key)
 
     def clear(self, site: str | None = None) -> None:
         """Disarm (one site or everything) and release any in-progress
@@ -129,13 +148,18 @@ class FaultRegistry:
             a = self._armed.get(site)
             return a.fired if a else 0
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, key: str | None = None) -> None:
         """Called at the named site. No-op unless armed; otherwise raises
         (``*_raise``), stalls (``step_stall``) or sleeps (``slow_step``)
-        per the armed spec."""
+        per the armed spec. ``key`` identifies the caller for the
+        replica-level sites: an armed spec carrying a key neither fires
+        NOR counts a hit for any other caller, so ``after=N`` lands on
+        replica K's N+1-th step regardless of what its siblings do."""
         with self._lock:
             a = self._armed.get(site)
-            if a is None or not a.should_fire():
+            if a is None or (a.key is not None and key != a.key):
+                return
+            if not a.should_fire():
                 return
             ms = a.ms
         if site == "conn_refused":
@@ -145,7 +169,7 @@ class FaultRegistry:
             raise ConnectionRefusedError(f"injected {site} (fire #{a.fired})")
         if site.endswith("_raise"):
             raise FaultError(f"injected {site} (fire #{a.fired})")
-        if site in ("step_stall", "recv_stall"):
+        if site in ("step_stall", "recv_stall", "replica_stall"):
             # block like the real hang: until released or ms elapses
             # (default: effectively forever — the watchdog's / the peer
             # heartbeat timeout's job)
@@ -175,11 +199,12 @@ class FaultRegistry:
             site, _, opts = part.partition(":")
             kw: dict = {}
             for opt in filter(None, (o.strip() for o in opts.split(";"))):
-                key, _, val = opt.partition("=")
-                if key not in ("after", "times", "ms"):
+                name, _, val = opt.partition("=")
+                if name not in ("after", "times", "ms", "key"):
                     raise ValueError(
                         f"bad DLLAMA_FAULTS option {opt!r} in {part!r}")
-                kw[key] = float(val) if key == "ms" else int(val)
+                kw[name] = (float(val) if name == "ms"
+                            else val if name == "key" else int(val))
             self.arm(site, **kw)
 
 

@@ -130,6 +130,19 @@ class PrefixCache:
             node = child
         return path
 
+    def match_len(self, tokens: list[int]) -> int:
+        """Read-only PEEK at the longest cached prefix (same whole-blocks,
+        len-1-capped rule as ``lookup_pin``), for the router's cache-aware
+        placement (runtime/router.py): no pin, no LRU touch, no stats —
+        a routing probe must not skew hit_rate or protect blocks. Called
+        from OUTSIDE the step mutex: the walk only READS children dicts
+        (GIL-atomic per access), so a concurrent publish/evict can at
+        worst make the answer transiently stale — which costs one
+        suboptimal placement, never correctness (the admission's own
+        lookup_pin re-walks under the mutex)."""
+        usable = max(len(tokens) - 1, 0) // self.block_len
+        return len(self._walk(tokens, usable)) * self.block_len
+
     def lookup_pin(self, tokens: list[int]):
         """Longest cached prefix usable for `tokens`: returns
         (n_tokens, block_ids, pins). The matched path is PINNED

@@ -83,9 +83,15 @@ class EngineSupervisor:
                  stall_timeout: float = 10.0, watchdog_poll: float = 0.02,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
                  breaker_threshold: int = 3,
-                 prefix_blocks: int = 0, prefix_block_len: int = 32):
+                 prefix_blocks: int = 0, prefix_block_len: int = 32,
+                 fault_key: str | None = None):
         self._factory = engine_factory
         self._chunk = chunk
+        # replica identity at the key-filtered fault sites (runtime/
+        # faults.py replica_raise/replica_stall) — every generation's
+        # scheduler carries it, so an armed kill follows THIS replica
+        # across rebuilds
+        self._fault_key = fault_key
         # prefix_blocks > 0 attaches a radix prefix cache
         # (runtime/prefix_cache.py) to every generation's scheduler. The
         # cache is minted FRESH in _make_sched: its block arena holds
@@ -122,6 +128,7 @@ class EngineSupervisor:
         # Scheduler.warmup) and /readyz must mean "will serve promptly"
         self._sched.warmup()
         self._loop_threads: dict[int, threading.Thread] = {}
+        self._rebuild_thread: threading.Thread | None = None
         self._start_loop(self._sched, self._gen)
         self._watchdog_thread = threading.Thread(
             target=self._watchdog, name="dllama-watchdog", daemon=True)
@@ -201,12 +208,21 @@ class EngineSupervisor:
             raise
 
     def close(self, timeout: float = 30.0) -> None:
+        end = time.perf_counter() + timeout
         with self._state_lock:
             self._stop = True
             self._state = CLOSED
             self._gen += 1  # invalidate every loop thread
             sched = self._sched
+            rebuild = self._rebuild_thread
         sched.close(timeout=timeout)
+        if rebuild is not None and rebuild.is_alive():
+            # a close that lands mid-rebuild must WAIT for the rebuild's
+            # factory/warmup to notice _stop: a daemon thread still inside
+            # an XLA compile when the interpreter finalizes is a segfault,
+            # not a clean exit (seen as intermittent rc=-11 in the bench
+            # subprocess after a kill-then-close chaos pass)
+            rebuild.join(timeout=max(end - time.perf_counter(), 1.0))
         if self._watchdog_thread.is_alive():
             self._watchdog_thread.join(timeout=max(self._poll * 10, 1.0))
 
@@ -266,8 +282,10 @@ class EngineSupervisor:
                 return
             self.sup_stats.consecutive_failures = 0
             self._state = RECOVERING
-        threading.Thread(target=self._rebuild,
-                         args=(time.perf_counter(),), daemon=True).start()
+            self._rebuild_thread = threading.Thread(
+                target=self._rebuild, args=(time.perf_counter(),),
+                daemon=True)
+        self._rebuild_thread.start()
 
     def summary(self) -> dict:
         """ServeStats summary with cross-generation counter totals folded
@@ -306,7 +324,7 @@ class EngineSupervisor:
                          max_queue=self.max_queue,
                          queue_timeout=self._queue_timeout,
                          request_deadline=self._request_deadline,
-                         prefix_cache=pc)
+                         prefix_cache=pc, fault_key=self._fault_key)
 
     def _start_loop(self, sched: Scheduler, gen: int) -> None:
         for g in [g for g, t in self._loop_threads.items()
@@ -374,8 +392,11 @@ class EngineSupervisor:
         # consumer code) and WITHOUT the step mutex (a wedged step holds
         # it forever) — see Scheduler._abort_all
         old._abort_all(f"engine failure: {msg}")
-        threading.Thread(target=self._rebuild, args=(t_detect,),
-                         daemon=True).start()
+        t = threading.Thread(target=self._rebuild, args=(t_detect,),
+                             daemon=True)
+        with self._state_lock:
+            self._rebuild_thread = t
+        t.start()
 
     def _rebuild(self, t_detect: float) -> None:
         """Backoff → factory → install → resume. Runs on its own thread
@@ -389,6 +410,8 @@ class EngineSupervisor:
                     return
             time.sleep(min(self._backoff_base * (2 ** max(n - 1, 0)),
                            self._backoff_max))
+            if self._stop:
+                return  # closed during backoff: skip the doomed compile
             try:
                 sched = self._make_sched(self._factory())
                 # compile while still unready — the watchdog only watches

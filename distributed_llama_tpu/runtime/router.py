@@ -1,0 +1,720 @@
+"""Replica failover: a fault-tolerant multi-replica serving tier.
+
+One supervised engine (runtime/resilience.py) survives its own crashes,
+but it is still ONE replica: a crash, stall, or tripped breaker takes the
+whole service down for its recovery window, and the ROADMAP's "heavy
+traffic" target cannot ride a single batch=B cache. This module puts a
+host-side router in front of N supervised replicas — threads on one host,
+each replica its own ``EngineSupervisor`` + ``Scheduler`` + radix prefix
+cache over SHARED weight buffers (the engine factory reuses the template
+engine's params, so N replicas cost N KV caches + arenas, never N weight
+copies) — and makes replica failure invisible to clients:
+
+  * CACHE-AWARE ROUTING in the SGLang style (PAPERS.md): each request is
+    placed on the replica whose radix tree holds its longest prefix
+    (``PrefixCache.match_len`` — a read-only peek), falling back to
+    least-loaded; ``session`` keys add stickiness so a conversation keeps
+    hitting the replica that already caches its history.
+  * BOUNDED AUTOMATIC RETRY: a request failed with a *retryable*
+    structured frame (``RequestError.retryable`` — crash/stall recovery
+    marks exactly these) BEFORE its first token streamed is resubmitted
+    onto a different healthy replica, up to ``retry_budget`` times, with
+    a fresh sampler rebuilt from the submit-time RNG snapshot — greedy
+    retries are therefore TOKEN-IDENTICAL to the run the dead replica
+    would have produced (tests/test_router.py pins this). A request that
+    already streamed tokens is NEVER silently replayed: the client gets
+    the structured frame re-raised with ``retryable=False`` (a partial
+    stream cannot be transparently retried; the client owns that choice).
+  * PER-REPLICA CIRCUIT BREAKERS with half-open probes, ABOVE the
+    supervisor's own engine-level breaker: a replica that keeps failing
+    requests while still claiming ready (flapping) is unrouted for
+    ``circuit_cooldown`` seconds, then offered exactly ONE probe request;
+    success closes the circuit, failure re-opens it.
+  * ROLLING DRAIN: ``drain_replica``/``restart_replica`` (and the
+    ``rolling_restart`` convenience) take replicas out of rotation one at
+    a time, finish their in-flight work, rebuild, and re-admit — an
+    operator restarts every replica with ZERO failed requests while the
+    service stays ready throughout (docs/operations.md runbook).
+
+``Router`` duck-types the ``EngineSupervisor`` surface the API server
+uses (``submit``, ``engine``, ``exclusive()``, ``ready``/``state``,
+``summary()``, ``drain()``, ``reset_breaker()``, ``close()``), so
+apps/api_server's handlers serve 1 or N replicas unchanged —
+``build_front_door`` below is the single constructor both paths share
+(the "engine owner" refactor that used to live inside ``ApiState``).
+
+Everything here is host-side thread scheduling: no new jitted entry
+points exist (each replica runs the same pinned slot_* executables), so
+the dlgrind fingerprint set is unchanged by construction.
+
+Chaos surface: each replica's scheduler carries ``fault_key="r{i}"``, so
+the ``replica_raise``/``replica_stall`` sites (runtime/faults.py) kill or
+wedge ONE replica deterministically mid-trace (tests/test_router.py, the
+``BENCH_ROUTER=1`` bench row).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from .resilience import _COUNTER_KEYS, EngineSupervisor, EngineUnready
+from .scheduler import QueueFull, RequestError, SchedulerClosed
+from .stats import RouterStats, percentile
+
+POLICIES = ("cache_aware", "least_loaded", "round_robin")
+
+# session-affinity map bound: conversations are transient, and an
+# unbounded dict on a long-lived router is a leak — the oldest stickiness
+# entries fall off first (losing one only costs a cold placement)
+_AFFINITY_CAP = 4096
+
+
+class ReplicaHandle:
+    """One supervised engine replica and its router-side health record —
+    the reusable "engine owner" split out of apps/api_server.ApiState:
+    it owns supervisor construction/rebuild for exactly one replica, so
+    the HTTP layer never touches an engine directly again.
+
+    The breaker fields (``fails``/``open_until``/``probing``) belong to
+    the ROUTER's circuit (guarded by the router's lock), layered above
+    the supervisor's own engine-level breaker: the supervisor answers
+    "can this engine serve at all", the router circuit answers "should
+    traffic go here right now"."""
+
+    def __init__(self, rid: int, engine_factory, sup_kwargs: dict):
+        self.id = rid
+        self._factory = engine_factory
+        self._sup_kwargs = dict(sup_kwargs)
+        self.sup = EngineSupervisor(engine_factory,
+                                    fault_key=f"r{rid}", **self._sup_kwargs)
+        self.draining = False   # router-level: out of rotation
+        # router circuit breaker (see class docstring)
+        self.fails = 0
+        self.open_until = 0.0   # 0 = closed; else half-open past it
+        self.probing = False
+
+    # -- health / placement signals ---------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return self.sup.ready
+
+    @property
+    def state(self) -> str:
+        return self.sup.state
+
+    def load(self) -> int:
+        """Live slots + queued requests — the least-loaded signal. Lock-
+        free reads of the current generation's scheduler (deque len and
+        slot scans are GIL-atomic enough for a placement heuristic)."""
+        sched = self.sup._sched
+        return (len(sched._queue)
+                + sum(1 for s in sched.slots if s.req is not None))
+
+    def match_len(self, tokens: list[int]) -> int:
+        """Longest prefix this replica's radix tree caches (0 with the
+        prefix cache off) — the cache-aware placement signal."""
+        pc = self.sup.prefix_cache
+        return pc.match_len(tokens) if pc is not None else 0
+
+    # -- lifecycle (rolling restart) --------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop routing here (the router checks ``draining``) and wait
+        for in-flight + queued work to finish. ROUTER-level only — the
+        supervisor stays READY underneath, so ``undrain`` can re-admit
+        without a rebuild (unlike EngineSupervisor.drain, whose DRAINING
+        state is one-way). Lock-free busy check, same discipline as the
+        supervisor's."""
+        self.draining = True
+        end = time.perf_counter() + timeout
+        while time.perf_counter() < end:
+            sched = self.sup._sched
+            if not sched._queue and all(s.req is None for s in sched.slots):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def restart(self, timeout: float = 30.0) -> None:
+        """Tear down and rebuild this replica's supervisor (fresh engine,
+        cache, empty prefix tree — weights still shared) and re-enter
+        rotation. Call after ``drain`` for a zero-failure rolling
+        restart; calling it hot aborts in-flight work with structured
+        shutdown frames (close()'s contract) first."""
+        self.draining = True
+        try:
+            # close FIRST, swap after: `sup` always points at a live
+            # object (the closed one answers ready=False/state=closed to
+            # concurrent health reads during the window — never None)
+            self.sup.close(timeout=timeout)
+            self.sup = EngineSupervisor(self._factory,
+                                        fault_key=f"r{self.id}",
+                                        **self._sup_kwargs)
+            self.fails = 0
+            self.open_until = 0.0
+            self.probing = False
+        finally:
+            self.draining = False
+
+    def undrain(self) -> None:
+        self.draining = False
+
+    def close(self, timeout: float = 30.0) -> None:
+        self.draining = True
+        if self.sup is not None:
+            self.sup.close(timeout=timeout)
+
+    def summary(self) -> dict:
+        s = self.sup.summary()
+        s["replica"] = self.id
+        s["draining"] = self.draining
+        s["breaker_open"] = self.open_until > 0.0
+        return s
+
+
+class RouterRequest:
+    """One client request as the router sees it: a thin stream wrapper
+    that owns the failover decision. ``tokens()`` streams the current
+    replica's events; a retryable structured failure BEFORE the first
+    token re-places the request (fresh sampler from the submit-time RNG
+    snapshot — token streams are attempt-invariant); any failure AFTER
+    tokens streamed re-raises the frame with ``retryable=False``.
+
+    Duck-types the consumer surface of ``ServeRequest``: ``tokens()``,
+    ``cancel()``, ``finished``, ``finish_reason``, ``stats``."""
+
+    def __init__(self, router: "Router", prompt: list[int], max_tokens: int,
+                 eos_id, deadline, sampler_spec: tuple, session):
+        self._router = router
+        self._prompt = prompt
+        self._max_tokens = max_tokens
+        self._eos_id = eos_id
+        self._deadline = deadline      # absolute: shared across attempts
+        self._sampler_spec = sampler_spec  # (vocab, temp, topp, rng_state)
+        self._session = session
+        self._inner = None             # current ServeRequest
+        self._handle: ReplicaHandle | None = None
+        self._probe = False            # current attempt IS the half-open probe
+        self._cancelled = False
+        self.retries = 0
+        self.emitted = 0
+        self.finished = threading.Event()
+        self.finish_reason: str | None = None
+
+    @property
+    def replica_id(self) -> int | None:
+        h = self._handle
+        return h.id if h is not None else None
+
+    @property
+    def stats(self):
+        """The CURRENT attempt's RequestStats (a failover's final stats
+        describe the attempt that actually served the client)."""
+        return self._inner.stats
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        if self._inner is not None:
+            self._inner.cancel()
+        if self._probe and self.emitted == 0 and not self.finished.is_set():
+            # cancelled before any token AND before (or instead of) the
+            # stream being consumed: tokens()'s settlement may never run,
+            # so release the armed probe here — idempotent if it does
+            self._router._release_probe(self._handle)
+
+    def _fresh_sampler(self):
+        from ..sampler import Sampler
+
+        vocab, temp, topp, rng_state = self._sampler_spec
+        return Sampler(vocab, temperature=temp, topp=topp, seed=rng_state)
+
+    def tokens(self, timeout: float = 600.0):
+        """Yield token ids to the terminal event, failing over between
+        replicas underneath (see class docstring). Raises RequestError
+        with the structured frame when the request ultimately fails."""
+        try:
+            yield from self._tokens(timeout)
+        finally:
+            if not self.finished.is_set():
+                # consumer abandoned the stream mid-flight (stop sequence,
+                # chat end-marker, client disconnect -> GeneratorExit): no
+                # terminal verdict will ever run _on_result, so settle the
+                # circuit accounting HERE. Tokens streamed = the replica
+                # served fine (success: resets fails, closes a probe);
+                # nothing streamed = no verdict — just release a probe so
+                # it can't leak probing=True and unroute the replica.
+                if self.emitted > 0:
+                    self._router._on_result(self._handle, ok=True,
+                                            retried=self.retries > 0)
+                elif self._probe:
+                    self._router._release_probe(self._handle)
+                self.finished.set()
+
+    def _tokens(self, timeout: float):
+        while True:
+            try:
+                for tok in self._inner.tokens(timeout=timeout):
+                    self.emitted += 1
+                    yield tok
+                self.finish_reason = self._inner.finish_reason
+                self._router._on_result(self._handle, ok=True,
+                                        retried=self.retries > 0)
+                self.finished.set()
+                return
+            except RequestError as e:
+                failed = self._handle
+                # breaker attribution: deadline/queue-budget expiries are
+                # the CLIENT's budget or the tier's load, not the
+                # replica's health — they must not open a healthy
+                # replica's circuit under pressure
+                if e.code not in ("deadline", "queue_timeout"):
+                    self._router._on_result(failed, ok=False)
+                elif self._probe:
+                    # the probe expired on the client's budget: no health
+                    # verdict either way — return the circuit to half-open
+                    # instead of leaking probing=True (which would unroute
+                    # the replica until a manual reset)
+                    self._router._release_probe(failed)
+                if self.emitted > 0:
+                    # mid-stream kill: the client already holds a partial
+                    # stream — surface the structured frame, explicitly
+                    # NON-retryable at this layer (a transparent replay
+                    # would re-emit tokens the client already rendered)
+                    with self._router._lock:  # counter discipline: every
+                        # RouterStats mutation rides the router lock
+                        self._router.stats.midstream_failures += 1
+                    self._terminal_error()
+                    raise RequestError(
+                        e.code, f"{e} [{self.emitted} tokens already "
+                                "streamed; not replayed — resubmit to "
+                                "regenerate]", retryable=False) from e
+                if (not e.retryable or self._cancelled
+                        or self.retries >= self._router.retry_budget):
+                    self._terminal_error()
+                    raise
+                try:
+                    self._router._place(
+                        self, exclude=(failed.id,) if failed else (),
+                        sampler=self._fresh_sampler())
+                except Exception:
+                    # no healthy replica to retry on: deliver the ORIGINAL
+                    # structured frame (still retryable — the client may
+                    # come back after recovery)
+                    self._terminal_error()
+                    raise e from None
+                self.retries += 1
+                with self._router._lock:
+                    self._router.stats.retries += 1
+
+    def _terminal_error(self) -> None:
+        self.finish_reason = "error"
+        self.finished.set()
+
+
+class Router:
+    """N supervised replicas behind one submit/stream surface. See the
+    module docstring for the policy and failure semantics; see
+    ``build_front_door`` for how the API server constructs one."""
+
+    def __init__(self, engine_factory, *, replicas: int = 2,
+                 policy: str = "cache_aware", retry_budget: int = 1,
+                 circuit_threshold: int = 3, circuit_cooldown: float = 5.0,
+                 **sup_kwargs):
+        # circuit_* name the ROUTER-level breaker so the supervisor's own
+        # breaker_threshold still rides **sup_kwargs without a collision
+        assert policy in POLICIES, policy
+        assert replicas >= 1, replicas
+        self.policy = policy
+        self.retry_budget = max(int(retry_budget), 0)
+        self.circuit_threshold = int(circuit_threshold)
+        self.circuit_cooldown = float(circuit_cooldown)
+        self.stats = RouterStats(replicas=replicas, policy=policy)
+        # the tier-level deadline default: resolved ONCE per request in
+        # submit() so a failover retry continues the ORIGINAL end-to-end
+        # budget — per-scheduler minting would grant each attempt a fresh
+        # window (x(1+retry_budget) the documented bound)
+        self._request_deadline = sup_kwargs.get("request_deadline")
+        self._lock = threading.RLock()  # placement + breaker + affinity
+        self._rr = 0
+        self._affinity: OrderedDict[str, int] = OrderedDict()
+        self._closed = False
+        # replicas build sequentially: each EngineSupervisor warms its
+        # executables before returning, and the XLA compile cache makes
+        # replicas 1..N-1 reuse replica 0's compilations
+        self.replicas: list[ReplicaHandle] = []
+        try:
+            for i in range(replicas):
+                self.replicas.append(
+                    ReplicaHandle(i, engine_factory, sup_kwargs))
+        except BaseException:
+            # replica K failed to build (e.g. the K+1-th KV cache/arena
+            # OOMs): close the K already-running supervisors — their step
+            # loop + watchdog threads and device memory must not outlive
+            # the constructor that raised
+            for h in self.replicas:
+                try:
+                    h.close(timeout=5.0)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            raise
+
+    # -- the supervisor surface the API server already speaks -------------
+
+    @property
+    def engine(self):
+        """Replica 0's engine — the shape/context template the handlers
+        read (seq_len etc.); never step it directly without exclusive()."""
+        return self.replicas[0].sup.engine
+
+    @property
+    def ready(self) -> bool:
+        """/readyz contract: the SERVICE is ready while >= 1 replica can
+        take traffic — single-replica failure must not unready the tier."""
+        now = time.perf_counter()
+        with self._lock:
+            return any(self._routable(h, now) for h in self.replicas)
+
+    @property
+    def state(self) -> str:
+        """Advisory tier state, CONSISTENT with ``ready``: "ready" iff
+        some replica is actually routable (supervisor-ready, not drained,
+        circuit allows) — a tier whose /readyz answers 503 must never
+        report state="ready" back at the operator."""
+        now = time.perf_counter()
+        with self._lock:
+            if any(self._routable(h, now) for h in self.replicas):
+                return "ready"
+            states = [h.state for h in self.replicas]
+            for s in ("recovering", "draining"):
+                if s in states:
+                    return s
+            if any(h.open_until > 0.0 for h in self.replicas):
+                # router circuits hold traffic off supervisor-ready
+                # replicas (the flapping case) — surface it, don't claim
+                # the supervisors' "ready"
+                return "degraded"
+            if any(h.draining for h in self.replicas):
+                # router-level drain leaves the supervisor READY
+                return "draining"
+            return states[0] if len(set(states)) == 1 else "degraded"
+
+    def submit(self, prompt, max_tokens, sampler, eos_id=None,
+               deadline=None, session=None) -> RouterRequest:
+        """Place one request (PromptTooLong/QueueFull/EngineUnready
+        surface here, exactly like the single-supervisor front door).
+        ``sampler`` is consumed by the first attempt; its (temperature,
+        topp, rng_state) snapshot — taken NOW, before any draw — rebuilds
+        an identical sampler for each failover attempt."""
+        if self._closed:
+            raise SchedulerClosed("router is closed")
+        if deadline is None and self._request_deadline:
+            deadline = time.perf_counter() + self._request_deadline
+        spec = (sampler.vocab_size, sampler.temperature, sampler.topp,
+                sampler.rng_state)
+        req = RouterRequest(self, [int(t) for t in prompt], max_tokens,
+                            eos_id, deadline, spec, session)
+        self._place(req, exclude=(), sampler=sampler)
+        return req
+
+    def exclusive(self):
+        """Borrow ONE routable replica's engine (Scheduler.exclusive via
+        its supervisor) — the legacy whole-batch endpoint's path. Lowest
+        routable id wins so repeat borrows hit a warm engine."""
+        now = time.perf_counter()
+        with self._lock:
+            targets = [h for h in self.replicas if self._routable(h, now)]
+        if not targets:
+            raise EngineUnready("no_replica", 1.0)
+        return targets[0].sup.exclusive()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Whole-service drain (SIGTERM shutdown path): every replica's
+        SUPERVISOR drains (one-way — admissions refused) within the
+        shared deadline."""
+        end = time.perf_counter() + timeout
+        ok = True
+        for h in self.replicas:
+            h.draining = True
+            ok &= h.sup.drain(timeout=max(end - time.perf_counter(), 0.1))
+        return ok
+
+    def reset_breaker(self, replica: int | None = None) -> None:
+        """Operator half-open for the ENGINE breaker (supervisor BROKEN)
+        plus a router-circuit reset — per replica or all."""
+        targets = (self.replicas if replica is None
+                   else [self.replicas[replica]])
+        with self._lock:
+            for h in targets:
+                h.fails = 0
+                h.open_until = 0.0
+                h.probing = False
+        for h in targets:
+            h.sup.reset_breaker()
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._closed = True
+        for h in self.replicas:
+            h.close(timeout=timeout)
+
+    def summary(self) -> dict:
+        """The /stats payload: aggregated counters (cross-replica AND
+        cross-generation — each supervisor already folds its dead
+        generations in), merged latency percentiles over the live
+        generations' request windows, the per-replica summaries, and the
+        router block."""
+        reps = [h.summary() for h in self.replicas]
+        out = {k: sum(r.get(k) or 0 for r in reps) for k in _COUNTER_KEYS}
+        ttfts, itls = [], []
+        for h in self.replicas:
+            for r in list(h.sup.stats.requests):
+                if r.ttft_ms is not None:
+                    ttfts.append(r.ttft_ms)
+                if r.itl_ms is not None:
+                    itls.append(r.itl_ms)
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        out.update({
+            "state": self.state,
+            "ttft_p50_ms": rnd(percentile(ttfts, 50)),
+            "ttft_p99_ms": rnd(percentile(ttfts, 99)),
+            "itl_p50_ms": rnd(percentile(itls, 50)),
+            "itl_p99_ms": rnd(percentile(itls, 99)),
+            "router": self.stats.summary(),
+            "replicas": reps,
+        })
+        return out
+
+    def _retry_after(self) -> float:
+        """Client hint while NO replica is routable: the soonest any
+        replica's own hint says to come back."""
+        return min((h.sup._retry_after() for h in self.replicas),
+                   default=1.0)
+
+    # -- rolling restart ---------------------------------------------------
+
+    def drain_replica(self, replica: int, timeout: float = 30.0) -> bool:
+        """Take ONE replica out of rotation and finish its in-flight work
+        (new traffic keeps flowing to its siblings). Follow with
+        restart_replica (rebuild + re-admit) or undrain_replica."""
+        with self._lock:
+            self.stats.drains += 1
+        return self.replicas[replica].drain(timeout=timeout)
+
+    def restart_replica(self, replica: int, timeout: float = 30.0) -> None:
+        h = self.replicas[replica]
+        with self._lock:
+            self.stats.restarts += 1
+        h.restart(timeout=timeout)
+        with self._lock:
+            # reset the router circuit AFTER the rebuild, under the lock:
+            # a concurrent _on_result for a request that died with the old
+            # generation must not interleave with restart's field clears
+            # and leave the circuit half-cleared against the fresh engine
+            h.fails = 0
+            h.open_until = 0.0
+            h.probing = False
+
+    def undrain_replica(self, replica: int) -> None:
+        self.replicas[replica].undrain()
+
+    def rolling_restart(self, timeout: float = 30.0) -> bool:
+        """The runbook recipe (docs/operations.md): drain + restart each
+        replica IN TURN — at most one replica is ever out of rotation, so
+        the service stays ready and no request is failed. Returns False
+        if any drain timed out (its stragglers got shutdown frames)."""
+        ok = True
+        for h in self.replicas:
+            ok &= self.drain_replica(h.id, timeout=timeout)
+            self.restart_replica(h.id, timeout=timeout)
+        return ok
+
+    # -- placement ---------------------------------------------------------
+
+    def _routable(self, h: ReplicaHandle, now: float) -> bool:
+        """May traffic go to h right now? Supervisor-ready AND not
+        draining AND the router circuit allows it (closed, or half-open
+        with no probe already in flight). Caller holds the lock."""
+        if h.draining or h.sup is None or not h.sup.ready:
+            return False
+        if h.open_until <= 0.0:
+            return True
+        if now < h.open_until:
+            return False          # circuit open: cooling down
+        return not h.probing      # half-open: one probe at a time
+
+    def _pick(self, prompt, session,
+              exclude) -> tuple[ReplicaHandle, str, bool]:
+        """Choose a replica (plus the reason, for stats, and whether this
+        pick IS the replica's half-open probe). Raises EngineUnready when
+        nothing is routable."""
+        if self.policy == "cache_aware":
+            # the radix walks are O(prompt) and lock-free-safe (match_len
+            # is a read-only peek; transiently stale is fine for routing)
+            # — do them BEFORE taking the placement lock so long prompts
+            # can't serialize every concurrent submit and /readyz probe
+            match = {h.id: h.match_len(prompt) for h in self.replicas
+                     if h.id not in exclude}
+        now = time.perf_counter()
+        with self._lock:
+            cands = [h for h in self.replicas
+                     if h.id not in exclude and self._routable(h, now)]
+            if not cands:
+                self.stats.no_replica_rejections += 1
+                raise EngineUnready("no_replica", self._retry_after())
+            if session is not None:
+                rid = self._affinity.get(session)
+                hit = next((h for h in cands if h.id == rid), None)
+                if hit is not None:
+                    self._affinity.move_to_end(session)
+                    return (hit, "affinity", self._mark_probe(hit, now))
+            if self.policy == "round_robin":
+                h = cands[self._rr % len(cands)]
+                self._rr += 1
+                return (h, "fallback", self._mark_probe(h, now))
+            if self.policy == "cache_aware":
+                best = max(match.get(h.id, 0) for h in cands)
+                if best > 0:
+                    warm = [h for h in cands if match.get(h.id, 0) == best]
+                    h = min(warm, key=lambda h: (h.load(), h.id))
+                    return (h, "cache_hit", self._mark_probe(h, now))
+            # least-loaded fallback (and the least_loaded policy itself)
+            h = min(cands, key=lambda h: (h.load(), h.id))
+            return (h, "fallback", self._mark_probe(h, now))
+
+    def _mark_probe(self, h: ReplicaHandle, now: float) -> bool:
+        """Arm the half-open probe if this pick crossed the cooldown.
+        Returns True iff THIS pick is the probe (the caller must release
+        it on a door refusal or a no-verdict expiry — see _release_probe)."""
+        if h.open_until > 0.0 and now >= h.open_until:
+            h.probing = True
+            self.stats.breaker_probes += 1
+            return True
+        return False
+
+    def _release_probe(self, h: ReplicaHandle | None) -> None:
+        """A probe attempt ended with NO health verdict (refused at the
+        door, or expired on the client's own deadline): re-open the
+        half-open window instead of leaking probing=True, which would
+        unroute the replica until a manual breaker reset."""
+        if h is None:
+            return
+        with self._lock:
+            h.probing = False
+
+    def _place(self, req: RouterRequest, exclude: tuple, sampler) -> None:
+        """Pick + submit, walking past replicas that refuse at the door
+        (went unready/closed between pick and submit, or queue-full) —
+        a door refusal is a placement miss, not a breaker-worthy request
+        failure. Re-raises the last refusal when every replica refused."""
+        tried = list(exclude)
+        last_exc: Exception | None = None
+        while True:
+            try:
+                h, reason, probe = self._pick(req._prompt, req._session,
+                                              tried)
+            except EngineUnready:
+                if isinstance(last_exc, (QueueFull, EngineUnready)):
+                    raise last_exc from None
+                raise
+            try:
+                inner = h.sup.submit(req._prompt, req._max_tokens, sampler,
+                                     eos_id=req._eos_id,
+                                     deadline=req._deadline)
+            except (EngineUnready, QueueFull, SchedulerClosed) as e:
+                if probe:
+                    self._release_probe(h)
+                tried.append(h.id)
+                last_exc = e
+                continue
+            except BaseException:
+                # anything else submit raises (PromptTooLong, bad-args
+                # ValueError) is the CALLER's error, not the replica's —
+                # propagate it, but never leak an armed probe with it
+                if probe:
+                    self._release_probe(h)
+                raise
+            with self._lock:
+                req._inner, req._handle = inner, h
+                req._probe = probe
+                self.stats.routed += 1
+                if reason == "cache_hit":
+                    self.stats.routed_cache_hit += 1
+                elif reason == "affinity":
+                    self.stats.routed_affinity += 1
+                else:
+                    self.stats.routed_fallback += 1
+                if req._session is not None:
+                    self._affinity[req._session] = h.id
+                    self._affinity.move_to_end(req._session)
+                    while len(self._affinity) > _AFFINITY_CAP:
+                        self._affinity.popitem(last=False)
+            if req._cancelled:
+                inner.cancel()
+            return
+
+    def _on_result(self, h: ReplicaHandle | None, ok: bool,
+                   retried: bool = False) -> None:
+        """Terminal accounting for one attempt on replica h: drives the
+        router circuit (consecutive request failures open it; any success
+        — including the half-open probe — closes it)."""
+        if h is None:
+            return
+        with self._lock:
+            if ok:
+                h.fails = 0
+                h.open_until = 0.0
+                h.probing = False
+                if retried:
+                    self.stats.failovers_ok += 1
+                return
+            h.fails += 1
+            now = time.perf_counter()
+            reopening = h.probing and h.open_until > 0.0
+            h.probing = False
+            if h.fails >= self.circuit_threshold or reopening:
+                if h.open_until <= 0.0 or reopening:
+                    self.stats.breaker_trips += 1
+                h.open_until = now + self.circuit_cooldown
+
+
+def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
+                     queue_depth: int = 0, request_deadline: float = 0.0,
+                     stall_timeout: float = 0.0, prefix_cache: bool = False,
+                     prefix_blocks: int = 0, prefix_block_len: int = 32,
+                     replicas: int = 1, retry_budget: int = 1,
+                     route_policy: str = "cache_aware"):
+    """The ONE constructor of the serving front door, shared by 1- and
+    N-replica deployments (the engine-owner logic that used to live in
+    apps/api_server.ApiState.scheduler): builds the per-replica engine
+    factory over ``engine``'s weights (param device buffers SHARED — a
+    replica costs one more KV cache + prefix arena, never another copy of
+    the model) and returns an ``EngineSupervisor`` (replicas == 1, the
+    exact PR-3 object) or a ``Router`` over N of them."""
+    from .engine import Engine
+
+    def engine_factory():
+        return Engine(engine.spec, engine.params, batch=serve_batch,
+                      max_seq_len=engine.seq_len,
+                      compute_dtype=engine.compute_dtype,
+                      cache_dtype=engine.cache_dtype,
+                      use_pallas=engine.use_pallas,
+                      pallas_interpret=engine.pallas_interpret,
+                      activation_q80=engine.activation_q80,
+                      prefill_chunk=engine.prefill_chunk)
+
+    n_blocks = 0
+    if prefix_cache:
+        n_blocks = prefix_blocks or max(
+            2 * serve_batch * engine.seq_len // prefix_block_len, 1)
+    sup_kwargs = dict(
+        chunk=serve_chunk or None,
+        max_queue=queue_depth or 4 * serve_batch,
+        request_deadline=request_deadline or None,
+        stall_timeout=stall_timeout or 10.0,
+        prefix_blocks=n_blocks, prefix_block_len=prefix_block_len)
+    if replicas <= 1:
+        return EngineSupervisor(engine_factory, **sup_kwargs)
+    return Router(engine_factory, replicas=replicas,
+                  policy=route_policy, retry_budget=retry_budget,
+                  **sup_kwargs)

@@ -194,8 +194,13 @@ class Scheduler:
     def __init__(self, engine, *, chunk: int | None = None,
                  max_queue: int = 0, queue_timeout: float | None = None,
                  request_deadline: float | None = None,
-                 prefix_cache=None):
+                 prefix_cache=None, fault_key: str | None = None):
         self.engine = engine
+        # identifies THIS scheduler at the replica-level fault sites
+        # (runtime/faults.py replica_raise/replica_stall): the router
+        # names replica i's scheduler "r{i}" so chaos tests can kill one
+        # replica deterministically while its siblings keep serving
+        self.fault_key = fault_key
         self.chunk = int(chunk or min(engine.prefill_chunk, engine.seq_len))
         assert 1 <= self.chunk <= engine.seq_len, self.chunk
         self.slots = [_Slot(i) for i in range(engine.batch)]
@@ -326,6 +331,11 @@ class Scheduler:
         FAULTS.fire("step_raise")
         FAULTS.fire("step_stall")
         FAULTS.fire("slow_step")
+        # replica-level sites: key-filtered, so an armed key=rK spec only
+        # counts/fires on replica K's working steps (other schedulers —
+        # including fault_key=None ones — pass through untouched)
+        FAULTS.fire("replica_raise", key=self.fault_key)
+        FAULTS.fire("replica_stall", key=self.fault_key)
         now = time.perf_counter()
         # reap cancellations and expired deadlines FIRST so a disconnected
         # client's request never burns another forward — in particular a

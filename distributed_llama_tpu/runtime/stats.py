@@ -228,6 +228,39 @@ class ClusterStats:
 
 
 @dataclasses.dataclass
+class RouterStats:
+    """Counters owned by runtime/router.Router — placement decisions,
+    failover retries, and per-replica breaker events, surfaced as the
+    ``router`` block of GET /stats (the per-replica supervisor summaries
+    ride the same payload as a ``replicas`` list)."""
+
+    replicas: int = 0
+    policy: str = ""
+    routed: int = 0             # successful placements (incl. retries)
+    routed_cache_hit: int = 0   # placements won by a radix prefix match
+    routed_affinity: int = 0    # placements won by session stickiness
+    routed_fallback: int = 0    # least-loaded / round-robin placements
+    retries: int = 0            # failover resubmits (pre-first-token)
+    failovers_ok: int = 0       # retried requests that then completed
+    midstream_failures: int = 0  # streams killed after >= 1 token: the
+    # structured NON-retryable frame the client saw (the router never
+    # silently replays a partially-delivered stream)
+    breaker_trips: int = 0      # router-level circuit opens
+    breaker_probes: int = 0     # half-open probe placements
+    drains: int = 0             # per-replica drains (rolling restart)
+    restarts: int = 0           # per-replica supervisor rebuilds
+    no_replica_rejections: int = 0  # submits with NO routable replica
+
+    def summary(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "replicas", "policy", "routed", "routed_cache_hit",
+            "routed_affinity", "routed_fallback", "retries",
+            "failovers_ok", "midstream_failures", "breaker_trips",
+            "breaker_probes", "drains", "restarts",
+            "no_replica_rejections")}
+
+
+@dataclasses.dataclass
 class SupervisorStats:
     """Resilience counters owned by runtime/resilience.EngineSupervisor —
     they survive scheduler rebuilds (each recovery mints a fresh

@@ -1095,3 +1095,256 @@ def test_api_batch_lookup_stream_crash_yields_structured_error(tmp_path,
         server.shutdown()
         if state._scheduler is not None:
             state._scheduler.close()
+
+
+# -- multi-replica router tier at the HTTP layer (ISSUE 6) ------------------
+
+
+def test_is_loopback_guard_shapes():
+    """The /admin/* guard: the whole IPv4 loopback block, ::1, and the
+    IPv6-mapped form pass; anything routable does not."""
+    from distributed_llama_tpu.apps.api_server import _is_loopback
+
+    for ok in ("127.0.0.1", "127.1.2.3", "::1", "::ffff:127.0.0.1"):
+        assert _is_loopback(ok), ok
+    for bad in ("10.0.0.1", "192.168.1.9", "0.0.0.0", "::ffff:10.0.0.1",
+                "2001:db8::1", "128.0.0.1"):
+        assert not _is_loopback(bad), bad
+
+
+@pytest.fixture
+def router_api_server(tmp_path, rng):
+    """Threaded server with the 2-replica failover router in front of the
+    continuous-batching scheduler (f32 for the same CPU-thunk reason as
+    the other scheduler fixtures)."""
+    mpath, tpath = _fixture(tmp_path, rng)
+    args = dllama.build_argparser().parse_args([
+        "api", "--model", mpath, "--tokenizer", tpath,
+        "--steps", "8", "--temperature", "0", "--seed", "3",
+        "--compute-dtype", "f32", "--cache-dtype", "f32"])
+    engine, tokenizer, sampler = dllama.build_engine(args)
+    state = ApiState(engine, tokenizer, sampler, model_name="tiny",
+                     serve_batch=2, serve_chunk=16, replicas=2,
+                     retry_budget=1)
+    from http.server import ThreadingHTTPServer
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address, state
+    server.shutdown()
+    if state._scheduler is not None:
+        state._scheduler.close()
+
+
+def test_api_router_serves_and_reports_replicas(router_api_server):
+    """The SAME handlers serve N replicas: a chat completion routes
+    through the Router, /readyz carries per-replica states, and /stats
+    aggregates counters with a `replicas` list + `router` block."""
+    (host, port), state = router_api_server
+    body = {"messages": [{"role": "user", "content": "ab"}],
+            "max_tokens": 4, "temperature": 0}
+    conn = http.client.HTTPConnection(host, port, timeout=240)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    out = json.loads(resp.read())
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/readyz")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ready = json.loads(resp.read())
+    assert ready["status"] == "ready"
+    assert set(ready["replicas"]) == {"r0", "r1"}
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/stats")
+    s = json.loads(conn.getresponse().read())
+    assert s["requests_finished"] >= 1
+    assert s["router"]["replicas"] == 2
+    assert s["router"]["routed"] >= 1
+    assert len(s["replicas"]) == 2
+    assert all("resilience" in r for r in s["replicas"])
+
+
+def test_api_router_replica_failure_invisible_to_client(router_api_server):
+    """Kill replica 0 mid-trace at the HTTP layer: the in-flight
+    not-yet-streamed request retries on replica 1 and the client sees a
+    clean 200 — byte-identical to the healthy answer — while /readyz
+    stays 200 throughout."""
+    from distributed_llama_tpu.runtime.faults import FAULTS
+
+    (host, port), state = router_api_server
+    body = {"messages": [{"role": "user", "content": "abba"}],
+            "max_tokens": 5, "temperature": 0}
+
+    def ask():
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    status, healthy = ask()  # also builds the router
+    assert status == 200
+    try:
+        FAULTS.arm("replica_raise", key="r0", times=1)
+        # the idle tie routes to r0 (its cache has no radix tree here, so
+        # no cache bias): it dies pre-first-token, the router fails over
+        status, failover = ask()
+        assert status == 200
+        assert failover["choices"][0]["message"]["content"] == \
+            healthy["choices"][0]["message"]["content"]
+        sup = state._scheduler
+        assert sup.stats.retries >= 1 or FAULTS.fired("replica_raise") == 0
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 200
+    finally:
+        FAULTS.clear()
+
+
+def test_api_admin_reset_breaker_restores_broken_service(sched_api_server):
+    """ISSUE 6 satellite: a BROKEN supervisor in api mode used to be an
+    outage only a Python REPL could end — POST /admin/reset_breaker is
+    the operator's HTTP half-open, and service resumes once the fault is
+    gone."""
+    import time as _time
+
+    from distributed_llama_tpu.runtime.faults import FAULTS
+    from distributed_llama_tpu.runtime.resilience import BROKEN, READY
+
+    (host, port), state = sched_api_server
+
+    def post(path, body):
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    status, _ = post("/v1/completions", {"prompt": "ab", "max_tokens": 2,
+                                         "temperature": 0})
+    assert status == 200
+    sup = state._scheduler
+    try:
+        FAULTS.arm("step_raise", times=0)  # every working step crashes
+        t0 = _time.perf_counter()
+        while sup.state != BROKEN and _time.perf_counter() - t0 < 60.0:
+            try:
+                post("/v1/completions", {"prompt": "ab", "max_tokens": 4,
+                                         "temperature": 0})
+            except Exception:  # noqa: BLE001 — a 503 path mid-recovery
+                pass
+            _time.sleep(0.05)
+        assert sup.state == BROKEN, sup.state
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 503
+        FAULTS.clear()  # the fault is gone; the operator closes the circuit
+        status, body = post("/admin/reset_breaker", {})
+        assert status == 200 and body["status"] == "ok"
+        t0 = _time.perf_counter()
+        while sup.state != READY and _time.perf_counter() - t0 < 30.0:
+            _time.sleep(0.05)
+        status, _ = post("/v1/completions", {"prompt": "ab",
+                                             "max_tokens": 2,
+                                             "temperature": 0})
+        assert status == 200
+    finally:
+        FAULTS.clear()
+
+
+def test_api_admin_replica_ops_rolling_restart(router_api_server):
+    """The rolling-restart recipe over HTTP: drain replica 0 (service
+    stays ready on replica 1), restart it, repeat for replica 1 — the
+    operator path docs/operations.md documents."""
+    (host, port), state = router_api_server
+
+    def post(path, body):
+        conn = http.client.HTTPConnection(host, port, timeout=240)
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    status, _ = post("/v1/completions", {"prompt": "ab", "max_tokens": 2,
+                                         "temperature": 0})
+    assert status == 200  # router built
+    for rid in (0, 1):
+        status, body = post("/admin/drain_replica", {"replica": rid})
+        assert status == 200 and body["status"] == "drained"
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        assert resp.status == 200  # the sibling keeps the service ready
+        assert json.loads(resp.read())["replicas"][f"r{rid}"].endswith(
+            "/draining")
+        status, body = post("/admin/restart_replica", {"replica": rid})
+        assert status == 200 and body["status"] == "restarted"
+        status, _ = post("/v1/completions", {"prompt": "ab",
+                                             "max_tokens": 2,
+                                             "temperature": 0})
+        assert status == 200
+    assert state._scheduler.stats.restarts == 2
+    # replica index validation is a clean 400
+    status, body = post("/admin/restart_replica", {"replica": 9})
+    assert status == 400 and "replica" in body["error"]
+
+
+def test_api_admin_on_single_replica_and_legacy(api_server):
+    """Admin endpoints never 404 by surprise: the legacy (no
+    --serve-batch) server answers with a clear 404 + remedy; replica ops
+    on a 1-replica server are a clean 400 (see the router fixture for the
+    happy path)."""
+    host, port = api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("POST", "/admin/reset_breaker", json.dumps({}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 404
+    assert "--serve-batch" in json.loads(resp.read())["error"]
+
+
+def test_api_healthz_readyz_all_modes_never_404(api_server,
+                                                sched_api_server,
+                                                router_api_server):
+    """ISSUE 6 satellite: a probe must never 404 depending on launch
+    flags — /healthz and /readyz answer on the legacy single-engine
+    server, the scheduler server, and the router server alike."""
+    targets = [api_server, sched_api_server[0], router_api_server[0]]
+    for host, port in targets:
+        for path in ("/healthz", "/health", "/readyz"):
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            assert resp.status in (200, 503), (host, port, path)
+            assert resp.status != 404, (host, port, path)
+            json.loads(resp.read())  # machine-readable either way
+
+
+def test_replica_flags_rejected_without_serve_batch():
+    """--replicas/--retry-budget/--route-policy are loud errors without
+    --serve-batch (and retry/policy without --replicas), same dead-flag
+    principle as the prefix-cache knobs — checked before any model
+    load."""
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--replicas", "2"])
+    assert "--serve-batch" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--serve-batch", "2", "--retry-budget", "3"])
+    assert "--replicas" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--serve-batch", "2", "--route-policy",
+                     "round_robin"])
+    assert "--replicas" in str(ei.value)
+    # an explicit 0 must hit the >= 1 error, not silently coerce to 1
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--serve-batch", "2", "--replicas", "0"])
+    assert ">= 1" in str(ei.value)

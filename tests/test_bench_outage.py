@@ -147,6 +147,50 @@ def test_prefix_row_emits_valid_json():
     json.dumps(p)  # the row round-trips as machine-readable JSON
 
 
+def test_router_row_emits_valid_json():
+    """BENCH_ROUTER=1 adds the 2-replica failover-router row
+    (bench._router_row). The acceptance bars ride the assertions:
+    cache-aware placement beats round-robin on prefix hit rate
+    (deterministic closed-loop A/B), the open-loop chaos pass with one
+    injected replica kill loses ZERO not-yet-streamed requests while
+    service-level readiness never blinks, and every completed request is
+    greedy token-identical across all three serves."""
+    r = _run_bench({
+        "BENCH_ROUTER": "1",
+        "BENCH_ROUTER_REQUESTS": "10",
+        "BENCH_ROUTER_GROUPS": "3",
+        "BENCH_ROUTER_SYS": "32",
+        "BENCH_ROUTER_BLOCK": "16",
+        "BENCH_ROUTER_TOKENS": "6",
+        "BENCH_ROUTER_KILL_AFTER": "4",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    rows = [v for v in row.get("variants", []) if "router" in v["metric"]]
+    assert len(rows) == 1, row
+    v = rows[0]
+    assert v["unit"] == "%" and v["replicas"] == 2
+    # cache-aware beats round-robin on the shared-prefix trace (the
+    # ISSUE-6 acceptance bar; closed-loop => deterministic, no timing luck)
+    assert v["hit_rate_gain_pct"] > 0, v
+    assert v["cache_aware"]["hit_rate_pct"] > \
+        v["round_robin"]["hit_rate_pct"], v
+    assert v["value"] == v["cache_aware"]["hit_rate_pct"]
+    # the chaos pass really killed a replica, and clients never saw an
+    # unstreamed request fail — only structured mid-stream frames
+    chaos = v["cache_aware_chaos"]
+    assert chaos["crashes_injected"] >= 1, chaos
+    assert chaos["unstreamed_failures"] == 0, chaos
+    assert chaos["completed"] + chaos["midstream_failures"] == 10, chaos
+    assert chaos["availability_pct"] is not None
+    assert chaos["availability_pct"] >= 99.0, chaos  # readiness held
+    assert v["token_parity"] is True
+    json.dumps(v)  # the row round-trips as machine-readable JSON
+
+
 def test_chaos_row_emits_valid_json():
     """BENCH_CHAOS=1 adds the fault-injection resilience row
     (bench._chaos_row): the Poisson trace replayed through the supervised

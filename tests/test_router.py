@@ -1,0 +1,591 @@
+"""Multi-replica failover router (runtime/router.py + runtime/faults.py
+replica sites).
+
+The chaos contract under test: with 2 replicas serving a fixed trace,
+killing one replica mid-trace yields ZERO client-visible failures for
+queued/not-yet-streamed requests (retried on the survivor, greedy tokens
+BIT-IDENTICAL to the single-engine oracle — cold or seeded prefix cache),
+structured NON-retryable error frames for mid-stream ones, and the
+service-level readiness (/readyz's ``router.ready``) stays True
+throughout; rolling drain of each replica in turn completes the full
+trace with zero failed requests. Placement is cache-aware (SGLang-style
+longest-prefix) with least-loaded fallback and session affinity, and a
+flapping replica is unrouted by the router's own circuit breaker until a
+half-open probe succeeds.
+
+Everything runs on CPU with count-deterministic, KEY-FILTERED fault
+injection (``replica_raise``/``replica_stall`` with ``key="rK"`` only
+count replica K's steps), so the kill lands on the same replica at the
+same step every run. f32 engines so parity assertions compare bit-exactly
+against the single-row oracle (same discipline as test_resilience.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.models.params import load_params, random_tensors
+from distributed_llama_tpu.runtime.engine import Engine
+from distributed_llama_tpu.runtime.faults import FAULTS, FaultError, FaultRegistry
+from distributed_llama_tpu.runtime.resilience import EngineUnready
+from distributed_llama_tpu.runtime.router import Router
+from distributed_llama_tpu.runtime.scheduler import (PromptTooLong, QueueFull,
+                                                     RequestError)
+from distributed_llama_tpu.sampler import Sampler
+
+SEQ = 64
+BL = 4  # prefix-cache block length: small so short prompts publish blocks
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=SEQ,
+                     hidden_act=HiddenAct.SILU)
+    host = random_tensors(spec, seed=3, scale=0.05)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    return spec, params
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _factory(tiny, batch=2):
+    spec, params = tiny
+
+    def make():
+        return Engine(spec, params, batch=batch, compute_dtype=jnp.float32,
+                      cache_dtype=jnp.float32)
+
+    return make
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+
+
+def _oracle(spec, params, prompt, max_tokens):
+    eng = Engine(spec, params, batch=1, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    return eng.generate(prompt, max_tokens, _greedy(spec)).tokens
+
+
+def _router(tiny, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("stall_timeout", 60.0)
+    kw.setdefault("backoff_base", 0.01)
+    return Router(_factory(tiny), **kw)
+
+
+def _wait(pred, timeout=30.0, poll=0.01):
+    end = time.perf_counter() + timeout
+    while time.perf_counter() < end:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# -- the key-filtered fault sites ----------------------------------------
+
+
+def test_replica_fault_key_filters_and_counts_per_replica():
+    """An armed key=r0 spec neither fires NOR counts a hit for other
+    callers — after=N stays deterministic per replica."""
+    r = FaultRegistry()
+    r.arm("replica_raise", key="r0", after=1)
+    r.fire("replica_raise", key="r1")   # other replica: not even a hit
+    r.fire("replica_raise", key=None)   # non-replica scheduler: ignored
+    r.fire("replica_raise", key="r0")   # hit 1: skipped by after=1
+    with pytest.raises(FaultError):
+        r.fire("replica_raise", key="r0")  # hit 2: fires
+    assert r.fired("replica_raise") == 1
+    # keyless arming keeps firing for any caller (backward compatible)
+    r.arm("replica_raise")
+    with pytest.raises(FaultError):
+        r.fire("replica_raise", key="r7")
+    # env-driven arming carries the key through DLLAMA_FAULTS
+    r2 = FaultRegistry()
+    r2.load_env({"DLLAMA_FAULTS": "replica_raise:key=r1;times=1"})
+    r2.fire("replica_raise", key="r0")
+    with pytest.raises(FaultError):
+        r2.fire("replica_raise", key="r1")
+
+
+# -- placement: cache-aware, affinity, fallback --------------------------
+
+
+def test_cache_aware_routing_prefers_warm_replica(tiny):
+    """The SGLang placement rule: a prompt whose prefix one replica's
+    radix tree caches routes there; cold prompts fall back least-loaded
+    (lowest id on an idle tie)."""
+    spec, params = tiny
+    router = _router(tiny, prefix_blocks=32, prefix_block_len=BL)
+    try:
+        p = [1, 9, 23, 54, 7, 11, 40, 3, 15]  # two whole BL-blocks publish
+        r1 = router.submit(p, 3, _greedy(spec))
+        assert list(r1.tokens(timeout=60.0)) == _oracle(spec, params, p, 3)
+        assert r1.replica_id == 0  # idle tie-break: lowest id
+        # replica 0 published p's prefix at prefill-finish: the repeat
+        # request must be placed by CACHE MATCH, not fallback
+        r2 = router.submit(p, 3, _greedy(spec))
+        assert list(r2.tokens(timeout=60.0)) == _oracle(spec, params, p, 3)
+        assert r2.replica_id == 0
+        assert router.stats.routed_cache_hit == 1
+        assert router.replicas[0].match_len(p) >= BL
+        assert router.replicas[1].match_len(p) == 0
+    finally:
+        router.close()
+
+
+def test_session_affinity_sticks_and_survives_policy(tiny):
+    spec, params = tiny
+    router = _router(tiny, policy="round_robin")
+    try:
+        q = [2, 40, 77, 5]
+        a = router.submit(q, 2, _greedy(spec), session="conv-1")
+        list(a.tokens(timeout=60.0))
+        # round_robin would alternate; affinity must override it
+        b = router.submit(q, 2, _greedy(spec), session="conv-1")
+        list(b.tokens(timeout=60.0))
+        assert a.replica_id == b.replica_id
+        assert router.stats.routed_affinity == 1
+    finally:
+        router.close()
+
+
+# -- failover: the headline parity contracts -----------------------------
+
+
+def test_failover_pre_first_token_token_parity_cold(tiny):
+    """A greedy request whose first replica is KILLED before its first
+    token streams must return bit-identical tokens from the surviving
+    replica (cold prefix cache), with no client-visible error."""
+    spec, params = tiny
+    router = _router(tiny, retry_budget=1)
+    try:
+        p = [1, 9, 23, 54, 7]
+        # kill replica 0's next WORKING step: the idle tie places p there
+        FAULTS.arm("replica_raise", key="r0")
+        req = router.submit(p, 6, _greedy(spec))
+        got = list(req.tokens(timeout=60.0))
+        assert got == _oracle(spec, params, p, 6)
+        assert req.retries == 1 and req.replica_id == 1
+        assert FAULTS.fired("replica_raise") == 1  # it DID die mid-trace
+        assert router.stats.retries == 1
+        assert router.stats.failovers_ok == 1
+        # replica 0 recovers behind the scenes; the service never blinked
+        assert _wait(lambda: router.replicas[0].ready, 30.0)
+    finally:
+        router.close()
+
+
+def test_failover_token_parity_seeded_prefix_cache(tiny):
+    """Same kill, but the SURVIVOR's radix tree already caches the
+    prompt's prefix: the retried request seeds from blocks and must STILL
+    be bit-identical (the PR-4 seeded==cold guarantee, now load-bearing
+    for failover)."""
+    spec, params = tiny
+    router = _router(tiny, retry_budget=1, prefix_blocks=32,
+                     prefix_block_len=BL)
+    try:
+        p = [1, 9, 23, 54, 7, 11, 40, 3, 15]
+        want = _oracle(spec, params, p, 6)
+        # warm BOTH replicas' trees directly (router placement would
+        # cache-route the second warmup to the first's replica)
+        for h in router.replicas:
+            w = h.sup.submit(p, 1, _greedy(spec))
+            assert list(w.tokens(timeout=60.0))
+        assert all(h.match_len(p) >= BL for h in router.replicas)
+        FAULTS.arm("replica_raise", key="r0")
+        req = router.submit(p, 6, _greedy(spec))
+        got = list(req.tokens(timeout=60.0))
+        assert got == want
+        assert req.retries == 1 and req.replica_id == 1
+        # the retry hit the survivor's cache (seeded, not cold)
+        pc = router.replicas[1].sup.prefix_cache
+        assert pc.stats.hits >= 1
+    finally:
+        router.close()
+
+
+def test_midstream_kill_emits_structured_nonretryable_frame(tiny):
+    """A request killed AFTER tokens streamed is never silently replayed:
+    the client gets the structured frame, retryable=False, and the
+    partial-stream count is in the message."""
+    spec, params = tiny
+    router = _router(tiny, retry_budget=3)
+    try:
+        FAULTS.arm("slow_step", times=0, ms=25.0)  # pace so the kill
+        # provably lands mid-stream, not after completion
+        req = router.submit([1, 9, 23], 40, _greedy(spec))
+        it = req.tokens(timeout=60.0)
+        got = [next(it)]  # the stream is LIVE
+        FAULTS.arm("replica_raise", key=f"r{req.replica_id}")
+        with pytest.raises(RequestError) as ei:
+            for t in it:
+                got.append(t)
+        assert ei.value.retryable is False
+        assert ei.value.code == "engine_error"
+        assert "already streamed" in str(ei.value)
+        assert req.finish_reason == "error"
+        assert router.stats.midstream_failures == 1
+        assert router.stats.retries == 0  # no silent replay happened
+    finally:
+        router.close()
+
+
+# -- the acceptance chaos trace ------------------------------------------
+
+
+def test_kill_one_replica_mid_trace_zero_unstreamed_failures(tiny):
+    """ISSUE 6 acceptance: a fixed Poisson trace over 2 replicas with
+    replica 0 killed mid-trace — every request either completes (retried
+    ones greedy-parity-checked against the oracle) or, ONLY if it already
+    streamed tokens, fails with the structured non-retryable frame; the
+    router stays ready the whole time (single-replica failure is
+    invisible at the service level)."""
+    spec, params = tiny
+    router = _router(tiny, retry_budget=1, circuit_threshold=100)
+    n_req, budget = 10, 6
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, spec.vocab_size, 5)]
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(0.05, n_req))
+    oracles = {i: _oracle(spec, params, p, budget)
+               for i, p in enumerate(prompts)}
+    results: dict = {}
+    ready_gaps = []
+    sampling = threading.Event()
+    sampling.set()
+
+    def sample_ready():
+        while sampling.is_set():
+            if not router.ready:
+                ready_gaps.append(time.perf_counter())
+            time.sleep(0.005)
+
+    def client(i):
+        req = router.submit(prompts[i], budget, _greedy(spec))
+        got = []
+        try:
+            for t in req.tokens(timeout=120.0):
+                got.append(t)
+            results[i] = ("ok", got, req.retries)
+        except RequestError as e:
+            results[i] = ("error", got, e)
+
+    try:
+        FAULTS.arm("slow_step", times=0, ms=20.0)  # pace: the trace must
+        # still be in flight when the kill lands
+        FAULTS.arm("replica_raise", key="r0", after=4)  # deterministic
+        # kill on replica 0's 5th working step, mid-trace
+        samp = threading.Thread(target=sample_ready, daemon=True)
+        samp.start()
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            dt = t0 + arrivals[i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            th = threading.Thread(target=client, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120.0)
+            assert not th.is_alive(), "a client hung"
+    finally:
+        sampling.clear()
+        FAULTS.clear()
+    assert FAULTS.fired("replica_raise") == 0  # cleared; it fired earlier
+    assert len(results) == n_req
+    errored = [i for i, r in results.items() if r[0] == "error"]
+    for i, r in results.items():
+        if r[0] == "ok":
+            assert r[1] == oracles[i], f"request {i} lost greedy parity"
+        else:
+            # ONLY mid-stream requests may fail, and only structurally
+            kind, got, exc = r
+            assert len(got) >= 1, \
+                f"request {i} failed with NO tokens streamed: {exc}"
+            assert exc.retryable is False
+    # the kill really happened and failover really ran
+    assert router.replicas[0].sup.sup_stats.crashes >= 1
+    assert router.stats.retries >= 1 or errored
+    # service-level readiness never blinked
+    assert not ready_gaps, f"router went unready at {ready_gaps}"
+    router.close()
+
+
+def test_rolling_drain_completes_trace_with_zero_failures(tiny):
+    """ISSUE 6 acceptance: rolling drain+restart of each replica in turn
+    while a trace is in flight — zero failed requests, service ready
+    throughout, both replicas rebuilt."""
+    spec, params = tiny
+    router = _router(tiny)
+    n_req, budget = 8, 4
+    rng = np.random.default_rng(1)
+    prompts = [[int(t) for t in rng.integers(1, spec.vocab_size, 5)]
+               for _ in range(n_req)]
+    results: dict = {}
+
+    def client(i):
+        try:
+            req = router.submit(prompts[i], budget, _greedy(spec))
+            results[i] = ("ok", list(req.tokens(timeout=120.0)))
+        except Exception as e:  # noqa: BLE001 — any failure fails the bar
+            results[i] = ("error", e)
+
+    try:
+        FAULTS.arm("slow_step", times=0, ms=15.0)  # keep work in flight
+        threads = []
+        for i in range(n_req):
+            th = threading.Thread(target=client, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(0.04)
+            if i == 2:
+                # roll both replicas mid-trace, one at a time
+                roller = threading.Thread(
+                    target=lambda: router.rolling_restart(timeout=60.0),
+                    daemon=True)
+                roller.start()
+        for th in threads:
+            th.join(timeout=120.0)
+            assert not th.is_alive()
+        roller.join(timeout=120.0)
+        assert not roller.is_alive()
+    finally:
+        FAULTS.clear()
+    assert len(results) == n_req
+    bad = {i: r for i, r in results.items() if r[0] != "ok"}
+    assert not bad, f"rolling drain failed requests: {bad}"
+    for i, (_, got) in results.items():
+        assert got == _oracle(spec, params, prompts[i], budget), i
+    assert router.stats.drains == 2 and router.stats.restarts == 2
+    assert router.ready
+    router.close()
+
+
+# -- router circuit breaker ----------------------------------------------
+
+
+def test_router_circuit_opens_and_half_open_probe_closes(tiny):
+    """A flapping replica (crashes every request but keeps recovering to
+    'ready') is unrouted after circuit_threshold consecutive failures;
+    after the cooldown exactly one half-open probe goes through, and its
+    success closes the circuit."""
+    spec, params = tiny
+    router = _router(tiny, retry_budget=1, circuit_threshold=2,
+                     circuit_cooldown=5.0, breaker_threshold=1000)
+    try:
+        p = [1, 9, 23, 54]
+        want = _oracle(spec, params, p, 3)  # built ONCE: engine
+        # construction inside the loop would eat the cooldown window
+        FAULTS.arm("replica_raise", key="r0", times=0)  # r0 flaps forever
+        for _ in range(2):  # two failovers attribute two failures to r0
+            assert _wait(lambda: router.replicas[0].ready, 30.0)
+            req = router.submit(p, 3, _greedy(spec))
+            assert list(req.tokens(timeout=60.0)) == want
+            assert req.retries == 1
+        assert router.stats.breaker_trips == 1
+        h0 = router.replicas[0]
+        assert h0.open_until > time.perf_counter()
+        # circuit open: traffic skips r0 even though its supervisor says
+        # ready — no retry needed, no crash burned
+        assert _wait(lambda: h0.ready, 30.0)
+        crashes_before = h0.sup.sup_stats.crashes
+        req = router.submit(p, 3, _greedy(spec))
+        assert list(req.tokens(timeout=60.0)) == want
+        assert req.replica_id == 1 and req.retries == 0
+        assert h0.sup.sup_stats.crashes == crashes_before
+        # fault gone + cooldown elapsed: the half-open probe lands on r0,
+        # succeeds, and closes the circuit
+        FAULTS.clear()
+        assert _wait(lambda: time.perf_counter() >= h0.open_until, 10.0)
+        req = router.submit(p, 3, _greedy(spec))
+        assert list(req.tokens(timeout=60.0)) == want
+        assert req.replica_id == 0
+        assert router.stats.breaker_probes == 1
+        assert h0.open_until == 0.0 and h0.fails == 0
+    finally:
+        router.close()
+
+
+def test_half_open_probe_door_refusal_returns_to_half_open(tiny):
+    """A half-open probe refused at the replica's DOOR (QueueFull /
+    EngineUnready before any request was placed) must not leak
+    probing=True — the circuit returns to half-open so a later pick can
+    probe again. Regression: the leak unrouted a healthy replica forever
+    (no terminal result ever ran _on_result), surviving until a manual
+    reset_breaker."""
+    spec, params = tiny
+    router = _router(tiny, circuit_threshold=1, circuit_cooldown=0.05)
+    try:
+        h0 = router.replicas[0]
+        assert _wait(lambda: h0.ready and router.replicas[1].ready, 30.0)
+        router._on_result(h0, ok=False)  # threshold 1: circuit opens
+        assert h0.open_until > 0.0
+        time.sleep(0.06)                 # past cooldown: next pick probes
+        real_submit = h0.sup.submit
+
+        def refuse_once(*a, **k):
+            h0.sup.submit = real_submit
+            raise QueueFull(1, 1)
+
+        h0.sup.submit = refuse_once
+        req = router.submit([1, 9], 2, _greedy(spec))  # probe refused -> r1
+        assert req.replica_id == 1
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, [1, 9], 2)
+        assert not h0.probing            # the leak: this used to stay True
+        # ...so the NEXT cold pick lands the probe on r0 and closes it
+        time.sleep(0.06)
+        req = router.submit([2, 7], 2, _greedy(spec))
+        assert req.replica_id == 0
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, [2, 7], 2)
+        assert h0.open_until == 0.0 and not h0.probing
+        assert router.stats.breaker_probes == 2
+    finally:
+        router.close()
+
+
+def test_probe_survives_caller_error_prompt_too_long(tiny):
+    """A CALLER error raised by the replica's door (PromptTooLong — an
+    HTTP-reachable 400) while that replica is half-open must propagate
+    to the client yet release the armed probe. Regression: the leak left
+    probing=True forever, so one oversized request permanently unrouted
+    a healthy replica."""
+    spec, params = tiny
+    router = _router(tiny, circuit_threshold=1, circuit_cooldown=0.05)
+    try:
+        h0 = router.replicas[0]
+        assert _wait(lambda: h0.ready and router.replicas[1].ready, 30.0)
+        router._on_result(h0, ok=False)   # threshold 1: circuit opens
+        time.sleep(0.06)                  # half-open: next pick probes r0
+        with pytest.raises(PromptTooLong):
+            router.submit(list(range(1, SEQ + 2)), 2, _greedy(spec))
+        assert not h0.probing             # the 400 did not eat the probe
+        # ...so a well-formed request can still probe r0 and close it
+        req = router.submit([5, 6], 2, _greedy(spec))
+        assert req.replica_id == 0
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, [5, 6], 2)
+        assert h0.open_until == 0.0 and h0.fails == 0
+    finally:
+        router.close()
+
+
+def test_abandoned_stream_settles_probe_and_circuit(tiny):
+    """A consumer that stops iterating mid-stream (text-level stop
+    sequence, chat end-marker, client disconnect) never reaches a
+    terminal event — generator teardown must still settle the router
+    circuit. Regression: a streamed-then-abandoned half-open probe
+    leaked probing=True (permanently unrouting the replica) and its
+    success never reset h.fails."""
+    spec, params = tiny
+    router = _router(tiny, circuit_threshold=1, circuit_cooldown=0.05)
+    try:
+        h0 = router.replicas[0]
+        assert _wait(lambda: h0.ready and router.replicas[1].ready, 30.0)
+        router._on_result(h0, ok=False)   # threshold 1: circuit opens
+        assert h0.open_until > 0.0
+        time.sleep(0.06)                  # half-open: next pick probes
+        req = router.submit([3, 11], 4, _greedy(spec))
+        assert req.replica_id == 0 and h0.probing
+        gen = req.tokens(timeout=60.0)
+        next(gen)                         # one token streamed, then the
+        gen.close()                       # consumer walks away
+        req.cancel()
+        assert not h0.probing             # teardown settled the probe...
+        assert h0.open_until == 0.0 and h0.fails == 0  # ...as a success
+        assert req.finished.is_set()
+        assert router.stats.breaker_probes == 1
+    finally:
+        router.close()
+
+
+def test_cancel_without_consuming_releases_probe(tiny):
+    """submit() arms the probe, but the caller cancels before ever
+    iterating tokens() (client gone pre-stream): neither a terminal
+    verdict nor generator teardown will run, so cancel() itself must
+    release the probe. Regression: the leak left probing=True forever."""
+    spec, params = tiny
+    router = _router(tiny, circuit_threshold=1, circuit_cooldown=0.05)
+    try:
+        h0 = router.replicas[0]
+        assert _wait(lambda: h0.ready and router.replicas[1].ready, 30.0)
+        router._on_result(h0, ok=False)   # threshold 1: circuit opens
+        time.sleep(0.06)                  # half-open: next pick probes r0
+        req = router.submit([7, 13], 3, _greedy(spec))
+        assert req.replica_id == 0 and req._probe
+        req.cancel()                      # never iterates tokens()
+        assert not h0.probing
+        # the replica can still be probed (and closed) by a later request
+        assert _wait(lambda: not any(
+            s.req is not None for s in h0.sup._sched.slots), 30.0)
+        time.sleep(0.06)
+        req = router.submit([8, 14], 2, _greedy(spec))
+        assert req.replica_id == 0
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, [8, 14], 2)
+        assert h0.open_until == 0.0
+    finally:
+        router.close()
+
+
+def test_no_routable_replica_is_structured_rejection(tiny):
+    """Every replica drained -> submit is a fast EngineUnready (the 503 +
+    Retry-After shape), counted; undrain restores service without a
+    rebuild (router-level drain keeps the supervisor READY)."""
+    spec, params = tiny
+    router = _router(tiny)
+    try:
+        for h in router.replicas:
+            assert h.drain(timeout=30.0)
+        with pytest.raises(EngineUnready) as ei:
+            router.submit([1, 9], 2, _greedy(spec))
+        assert ei.value.retry_after > 0
+        assert router.stats.no_replica_rejections == 1
+        assert not router.ready
+        router.undrain_replica(0)
+        assert router.ready
+        req = router.submit([1, 9], 2, _greedy(spec))
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, [1, 9], 2)
+        assert req.replica_id == 0
+    finally:
+        router.close()
+
+
+def test_router_summary_aggregates_and_reports_replicas(tiny):
+    spec, params = tiny
+    router = _router(tiny)
+    try:
+        for _ in range(2):
+            req = router.submit([1, 9, 23], 2, _greedy(spec))
+            list(req.tokens(timeout=60.0))
+        s = router.summary()
+        assert s["state"] == "ready"
+        assert s["requests_finished"] == 2
+        assert s["tokens_out"] == 4
+        assert s["ttft_p50_ms"] is not None
+        assert len(s["replicas"]) == 2
+        assert {r["replica"] for r in s["replicas"]} == {0, 1}
+        assert s["router"]["routed"] == 2
+        assert s["router"]["policy"] == "cache_aware"
+        # per-replica summaries carry their own resilience blocks
+        assert all("resilience" in r for r in s["replicas"])
+    finally:
+        router.close()
