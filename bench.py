@@ -43,7 +43,12 @@ to add the fault-injection resilience row (_chaos_row), BENCH_ROUTER=1 to
 add the 2-replica failover-router row (_router_row; cache-aware vs
 round-robin placement + one injected replica kill —
 BENCH_ROUTER_REQUESTS/_BATCH/_GROUPS/_SYS/_BLOCK/_BLOCKS/_TOKENS/
-_KILL_AFTER size it).
+_KILL_AFTER size it) plus the PROCESS-mode row (_router_procs_row; two
+real replica worker OS processes, one SIGKILLed mid-trace —
+respawn-to-routable ms, availability %, zero unstreamed failures, token
+parity; BENCH_PROCS_REQUESTS/_TOKENS/_KILL_AFTER/_STEP_MS/
+_SPAWN_TIMEOUT size it; BENCH_ROUTER_PROCS=0 skips it, =only runs just
+it).
 """
 
 from __future__ import annotations
@@ -1071,6 +1076,213 @@ def _router_row(params, spec: ModelSpec, prefix: str, b: int = 2) -> dict:
     }
 
 
+def _router_procs_row(prefix: str) -> dict:
+    """Process-isolated replica tier (the ISSUE-7 metric): spawn TWO real
+    replica worker OS processes (runtime/replica_worker.py — each its own
+    single-process CPU-JAX interpreter over deterministic synthetic
+    weights, served through the framed replica protocol), drive an
+    open-loop Poisson trace through the failover router, and deliver a
+    REAL ``SIGKILL -9`` to one worker mid-trace. Reported:
+
+      * kill_to_routable_ms / respawn_p50_ms — death -> the respawned
+        worker is warmed and routable again (the supervised-respawn
+        bound the chaos tests pin);
+      * availability_pct — router readiness sampled at 5 ms: the sibling
+        replica must keep the SERVICE ready through the whole outage;
+      * unstreamed_failures — requests that failed with zero tokens
+        streamed: must be 0 (the connection EOF is a structured
+        retryable frame, failed over to the sibling within the retry
+        budget); mid-stream casualties get the structured non-retryable
+        frame and are counted separately, never silently replayed;
+      * token_parity — every completed serve of the same prompt (either
+        replica, pre- or post-kill, failover replays, the respawned
+        process) produced IDENTICAL greedy tokens. Compared pairwise
+        across completions, so the bar is backend-independent: both
+        workers hold bit-identical params by construction (same
+        spec/seed), and the respawned one reloads exactly them.
+
+    Workers pace decode via a worker-side ``slow_step`` fault so the kill
+    provably lands while streams are in flight. Env knobs:
+    BENCH_PROCS_REQUESTS (default 10), BENCH_PROCS_TOKENS (decode budget,
+    default 6), BENCH_PROCS_KILL_AFTER (requests submitted before the
+    kill, default half the trace), BENCH_PROCS_STEP_MS (decode pacing,
+    default 40), BENCH_PROCS_SPAWN_TIMEOUT (startup/respawn bound,
+    default 300 s — includes the worker's jax import + tiny-model
+    compile on a cold XLA cache)."""
+    import gc
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+
+    from distributed_llama_tpu.runtime.replica_worker import WorkerProc
+    from distributed_llama_tpu.runtime.router import (RemoteReplicaHandle,
+                                                      Router)
+    from distributed_llama_tpu.runtime.scheduler import RequestError
+    from distributed_llama_tpu.sampler import Sampler
+
+    n_req = max(int(os.environ.get("BENCH_PROCS_REQUESTS", "10")), 4)
+    budget = int(os.environ.get("BENCH_PROCS_TOKENS", "6"))
+    kill_after = int(os.environ.get("BENCH_PROCS_KILL_AFTER",
+                                    str(n_req // 2)))
+    step_ms = int(os.environ.get("BENCH_PROCS_STEP_MS", "40"))
+    spawn_timeout = float(os.environ.get("BENCH_PROCS_SPAWN_TIMEOUT",
+                                         "300"))
+
+    spec_fields = dict(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=128)
+    cfg = {"test_spec": spec_fields, "seed": 11, "scale": 0.05,
+           "compute_dtype": "f32", "batch": 2,
+           # the survivor absorbs the whole trace during the outage —
+           # its admission queue must hold every not-yet-served request
+           "serve": {"stall_timeout": 60.0, "max_queue": n_req}}
+    # workers are single-process CPU JAX regardless of the bench backend
+    # (the process tier is host-side plumbing; the chip stays with the
+    # parent's measured rows); they share one persistent XLA compilation
+    # cache so worker 1 and every respawn reuse worker 0's compiles
+    wenv = {"JAX_PLATFORMS": "cpu",
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                os.path.expanduser("~"), ".cache", "dllama_tpu_xla"),
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1.0"}
+    workdir = tempfile.mkdtemp(prefix="dllama-bench-procs-")
+
+    def mk(i):
+        proc = WorkerProc(i, dict(cfg, fault_key=f"r{i}"), workdir=workdir,
+                          env=wenv,
+                          faults=f"slow_step:times=0;ms={step_ms}")
+        return RemoteReplicaHandle(i, proc=proc, poll_interval=0.1,
+                                   spawn_backoff_base=0.05,
+                                   spawn_timeout=spawn_timeout,
+                                   respawn_timeout=spawn_timeout)
+
+    # spawn the two worker processes CONCURRENTLY (handle construction
+    # blocks on the port handshake — import + weight build + warmup):
+    # the row measures kill-to-routable, not cold-start serialization
+    handles: list = [None, None]
+    builders = [threading.Thread(target=lambda i=i: handles.__setitem__(
+        i, mk(i))) for i in (0, 1)]
+    for t in builders:
+        t.start()
+    for t in builders:
+        t.join()
+    if any(h is None for h in handles):
+        for h in handles:
+            if h is not None:
+                h.close()  # don't orphan the sibling that DID come up
+        raise RuntimeError("replica worker spawn failed (see workdir logs)")
+
+    rng = np.random.default_rng(3)
+    # each distinct prompt appears (at least) twice in the trace — the
+    # parity bar compares completions of the same prompt pairwise
+    distinct = [rng.integers(1, spec_fields["vocab_size"],
+                             12 + 4 * (i % 3)).astype(np.int64).tolist()
+                for i in range(max(n_req // 2, 1))]
+    prompts = [distinct[i % len(distinct)] for i in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(0.08, n_req))
+
+    def greedy():
+        return Sampler(spec_fields["vocab_size"], temperature=0.0,
+                       topp=0.9, seed=5)
+
+    router = Router(None, policy="round_robin", retry_budget=1,
+                    handle_factories=[lambda: handles[0],
+                                      lambda: handles[1]])
+    h0 = router.replicas[0]
+    outs: dict = {}
+    errs: dict = {}
+    ready_samples: list = []
+    sampling = threading.Event()
+    sampling.set()
+
+    def sample_ready():
+        while sampling.is_set():
+            ready_samples.append(router.ready)
+            _time.sleep(0.005)
+
+    def client(i):
+        got: list = []
+        try:
+            req = router.submit(prompts[i], budget, greedy())
+            for t in req.tokens(timeout=300.0):
+                got.append(t)
+            outs[i] = got
+        except RequestError as e:
+            errs[i] = (len(got), e)
+        except Exception as e:  # noqa: BLE001 — no-replica rejection
+            errs[i] = (len(got), e)
+
+    kill_to_routable_ms = None
+    try:
+        samp = threading.Thread(target=sample_ready, daemon=True)
+        samp.start()
+        threads = []
+        t_kill = None
+        t0 = _time.perf_counter()
+        for i in range(n_req):
+            dt = t0 + arrivals[i] - _time.perf_counter()
+            if dt > 0:
+                _time.sleep(dt)
+            t = threading.Thread(target=client, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+            if i + 1 == kill_after:
+                t_kill = _time.perf_counter()
+                os.kill(h0._proc.proc.pid, _signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=300.0)
+        # supervised respawn: keep sampling readiness until the killed
+        # replica is routable again (the acceptance bound)
+        end = _time.perf_counter() + spawn_timeout
+        while _time.perf_counter() < end and not h0.ready:
+            _time.sleep(0.01)
+        if h0.ready and t_kill is not None:
+            kill_to_routable_ms = (_time.perf_counter() - t_kill) * 1e3
+        # the respawned process SERVES: one more lap of the trace's first
+        # two prompts so round_robin provably lands one on each replica
+        for i in (0, 1):
+            req = router.submit(prompts[i], budget, greedy())
+            outs[n_req + i] = list(req.tokens(timeout=300.0))
+            prompts.append(prompts[i])
+    finally:
+        sampling.clear()
+        proc_stats = h0.proc_stats.summary()
+        stats = router.stats
+        router.close()
+        gc.collect()
+
+    by_prompt: dict = {}
+    for i, toks in outs.items():
+        by_prompt.setdefault(tuple(prompts[i]), []).append(toks)
+    parity = all(all(o == serves[0] for o in serves)
+                 for serves in by_prompt.values())
+    return {
+        "metric": f"{prefix}_router_procs_sigkill_respawn_ms",
+        "value": (None if kill_to_routable_ms is None
+                  else round(kill_to_routable_ms, 1)),
+        "unit": "ms", "vs_baseline": None,
+        "mode": "process", "replicas": 2, "requests": n_req,
+        "decode_step_ms": step_ms,
+        "kill_to_routable_ms": (None if kill_to_routable_ms is None
+                                else round(kill_to_routable_ms, 1)),
+        "respawn_p50_ms": proc_stats["respawn_p50_ms"],
+        "respawns": proc_stats["respawns"],
+        "exit_classes": proc_stats["exit_classes"],
+        "availability_pct": round(
+            100.0 * sum(ready_samples) / len(ready_samples), 2)
+        if ready_samples else None,
+        "completed": len(outs),
+        "unstreamed_failures": sum(1 for n, _ in errs.values() if n == 0),
+        "midstream_failures": sum(1 for n, _ in errs.values() if n > 0),
+        "retries": stats.retries,
+        "failovers_ok": stats.failovers_ok,
+        "token_parity": parity,
+        # the acceptance bars ride the row
+        "within_bound": (kill_to_routable_ms is not None
+                         and kill_to_routable_ms / 1e3 < spawn_timeout),
+        "spawn_timeout_s": spawn_timeout,
+    }
+
+
 def _cluster_chaos_row(prefix: str) -> dict:
     """Cluster worker-loss detection latency (the ISSUE-5 metric): spawn
     REAL two-OS-process control-plane clusters (parallel/cluster_harness
@@ -1382,8 +1594,19 @@ def main() -> None:
             # prefix trace at 2 replicas, cache-aware vs round-robin
             # placement, with one replica killed mid-trace — hit-rate
             # gain, availability %, zero-unstreamed-failure count
-            emit(_router_row(params, spec,
-                             prefix=metric.split("_decode")[0]))
+            # BENCH_ROUTER_PROCS selects the tier(s): "1" (default) =
+            # thread row + process row, "0" = thread row only, "only" =
+            # process row only (the smoke tests pick one each)
+            procs_knob = os.environ.get("BENCH_ROUTER_PROCS", "1")
+            if procs_knob != "only":
+                emit(_router_row(params, spec,
+                                 prefix=metric.split("_decode")[0]))
+            if procs_knob != "0":
+                # process-mode row (runtime/replica_worker.py): two real
+                # worker OS processes, one SIGKILLed mid-trace —
+                # respawn-to-routable latency, availability %, zero
+                # unstreamed failures, token parity
+                emit(_router_procs_row(prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_CHAOS", "0") != "0":
             # resilience row (runtime/resilience.py): the Poisson trace

@@ -73,6 +73,24 @@ def _is_loopback(addr: str) -> bool:
     return addr == "::1" or addr.startswith("127.")
 
 
+def _admin_authorized(state: "ApiState", client_addr: str,
+                      auth_header: str | None) -> bool:
+    """May this caller use /admin/*? Loopback always can (the SSHed
+    operator). Off-loopback needs ``--admin-token``: remote-replica
+    deployments put the operator on another machine, where loopback-only
+    was an outage (the breaker could not be reset over the network).
+    The compare is constant-time (hmac.compare_digest) so the token
+    cannot be recovered byte-at-a-time from response timing."""
+    if _is_loopback(client_addr):
+        return True
+    if not state.admin_token or not auth_header:
+        return False
+    import hmac
+
+    expected = "Bearer " + state.admin_token
+    return hmac.compare_digest(auth_header.encode(), expected.encode())
+
+
 def build_chat_prompt(messages: list[dict]) -> str:
     """Llama-3 header template (ref: dllama-api.cpp:173-181)."""
     out = []
@@ -90,7 +108,10 @@ class ApiState:
                  request_deadline: float = 0.0, stall_timeout: float = 0.0,
                  prefix_cache: bool = False, prefix_blocks: int = 0,
                  prefix_block_len: int = 32, replicas: int = 1,
-                 retry_budget: int = 1, route_policy: str = "cache_aware"):
+                 retry_budget: int = 1, route_policy: str = "cache_aware",
+                 replica_procs: int = 0, replica_hosts=None,
+                 worker_config: dict | None = None,
+                 admin_token: str | None = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -133,10 +154,27 @@ class ApiState:
         self.replicas = replicas
         self.retry_budget = retry_budget
         self.route_policy = route_policy
+        # PROCESS-isolated replica tier (runtime/replica_worker.py):
+        # replica_procs spawns N supervised worker processes locally
+        # (each its own interpreter — a segfault/SIGKILL/OOM costs one
+        # process, not the service); replica_hosts connects to
+        # pre-started workers at [(host, port), ...] instead
+        self.replica_procs = replica_procs
+        self.replica_hosts = replica_hosts
+        self.worker_config = worker_config
+        # optional bearer token for /admin/*: remote-replica operators
+        # are not on loopback, so --admin-token is the non-local
+        # alternative to _is_loopback (constant-time compare)
+        self.admin_token = admin_token
         # serializes legacy single-engine requests under the threaded
         # accept loop (the scheduler path needs no lock — it queues)
         self.engine_lock = threading.RLock()
         self._scheduler = None
+        # router mode = any multi-handle tier (thread, process, or
+        # remote): gates session affinity and the per-replica /readyz
+        # payload independent of WHICH tier is configured
+        self.router_mode = bool(replicas > 1 or replica_procs
+                                or replica_hosts)
         # multihost root: set to the ClusterPeerLost when the control
         # plane detects a dead/wedged worker — /readyz answers 503
         # cluster_lost during the brief window before the diagnostic exit
@@ -169,7 +207,10 @@ class ApiState:
                     prefix_block_len=self.prefix_block_len,
                     replicas=self.replicas,
                     retry_budget=self.retry_budget,
-                    route_policy=self.route_policy)
+                    route_policy=self.route_policy,
+                    replica_procs=self.replica_procs,
+                    replica_hosts=self.replica_hosts,
+                    worker_config=self.worker_config)
             return self._scheduler
 
     def batch_engine(self):
@@ -395,7 +436,7 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
     # PromptTooLong raises HERE (before any event) — the handler still
     # turns it into a clean 400 through the queued/threaded path
     kwargs = {}
-    if state.replicas > 1:
+    if state.router_mode:
         # multi-replica tier: the OpenAI `user` field (or an explicit
         # `session`) keys replica stickiness, so a conversation keeps
         # hitting the replica whose radix tree caches its history
@@ -812,7 +853,7 @@ def make_handler(state: ApiState):
             else:
                 sup = state._scheduler
                 payload = {"state": sup.state}
-                if state.replicas > 1:
+                if state.router_mode:
                     # multi-replica tier: readiness is ANY-replica (one
                     # failure must not unready the service); the per-
                     # replica states ride along for the operator
@@ -877,9 +918,11 @@ def make_handler(state: ApiState):
               POST /admin/undrain_replica {replica: i}
                    — the rolling-restart recipe, one replica at a time
                    (multi-replica servers only)."""
-            if not _is_loopback(self.client_address[0]):
-                self._json(403, {"error": "admin endpoints are "
-                                          "loopback-only by default"})
+            if not _admin_authorized(state, self.client_address[0],
+                                     self.headers.get("Authorization")):
+                self._json(403, {"error": "admin endpoints need loopback "
+                                          "or a valid --admin-token "
+                                          "bearer"})
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -906,9 +949,9 @@ def make_handler(state: ApiState):
             is_router = isinstance(sup, Router)
             if replica is not None and not (
                     is_router and 0 <= replica < len(sup.replicas)):
+                n = len(sup.replicas) if is_router else 1
                 self._json(400, {"error": f"no replica {replica} "
-                                 "(--replicas "
-                                 f"{state.replicas if is_router else 1})"})
+                                 f"(tier has {n})"})
                 return
             if self.path == "/admin/reset_breaker":
                 if is_router:
@@ -1207,23 +1250,65 @@ def serve(args) -> None:
     if replicas < 1:
         # explicit `--replicas 0` must hit this, not coerce to 1
         sys.exit("error: --replicas must be >= 1")
+    replica_procs = getattr(args, "replica_procs", 0) or 0
+    replica_hosts_raw = getattr(args, "replica_hosts", None)
+    if replica_procs < 0:
+        sys.exit("error: --replica-procs must be >= 1")
+    if replica_procs and replica_hosts_raw:
+        sys.exit("error: --replica-procs (local spawn) and "
+                 "--replica-hosts (connect to pre-started workers) are "
+                 "mutually exclusive")
+    process_tier = bool(replica_procs or replica_hosts_raw)
+    if process_tier and replicas > 1:
+        sys.exit("error: --replicas (thread tier) does not compose with "
+                 "--replica-procs/--replica-hosts (process tier) — pick "
+                 "one replication boundary")
+    if process_tier and getattr(args, "nnodes", 1) > 1:
+        sys.exit("error: --replica-procs/--replica-hosts do not compose "
+                 "with --nnodes (each worker is its own single-host "
+                 "engine; see ROADMAP item 2 for the composition)")
     if not serve_batch and (
-            replicas > 1
+            replicas > 1 or process_tier
             or getattr(args, "retry_budget", None) is not None
             or getattr(args, "route_policy", None) is not None):
         # the router fronts N slot schedulers — without --serve-batch
         # these flags would be silently dead configuration (retry-budget
         # and route-policy use None sentinels so even an explicit
         # default value is caught)
-        sys.exit("error: --replicas/--retry-budget/--route-policy "
-                 "require --serve-batch N (the failover router fronts "
-                 "the continuous-batching scheduler)")
-    if replicas == 1 and (getattr(args, "retry_budget", None) is not None
-                          or getattr(args, "route_policy", None) is not None):
+        sys.exit("error: --replicas/--replica-procs/--replica-hosts/"
+                 "--retry-budget/--route-policy require --serve-batch N "
+                 "(the failover router fronts the continuous-batching "
+                 "scheduler)")
+    if replicas == 1 and not process_tier and (
+            getattr(args, "retry_budget", None) is not None
+            or getattr(args, "route_policy", None) is not None):
         sys.exit("error: --retry-budget/--route-policy have no effect "
-                 "without --replicas N > 1")
+                 "without --replicas N > 1 or a process tier")
+    replica_hosts = None
+    if replica_hosts_raw:
+        replica_hosts = []
+        for spec in str(replica_hosts_raw).split(","):
+            host, _, port = spec.strip().rpartition(":")
+            if not host or not port.isdigit():
+                sys.exit(f"error: --replica-hosts entry {spec.strip()!r} "
+                         "is not host:port")
+            replica_hosts.append((host, int(port)))
+    worker_config = None
+    if replica_procs:
+        if not getattr(args, "model", None):
+            sys.exit("error: --replica-procs workers load their own "
+                     "weights and need --model")
+        from ..runtime.replica_worker import config_from_cli_args
+        worker_config = config_from_cli_args(args, serve_batch)
 
-    engine, tokenizer, sampler = build_engine(args)
+    if process_tier:
+        # the workers own the weights — the parent reads only the .m
+        # spec header (shape validation) + tokenizer: no N+1-th weight
+        # copy locally, and a pure --replica-hosts router box holds none
+        from .dllama import build_front_template
+        engine, tokenizer, sampler = build_front_template(args)
+    else:
+        engine, tokenizer, sampler = build_engine(args)
     prefix_block_len = getattr(args, "prefix_block_len", None) or 32
     if getattr(args, "prefix_cache", False):
         # validate the arena config against the REAL engine context at
@@ -1250,7 +1335,11 @@ def serve(args) -> None:
                      retry_budget=(1 if getattr(args, "retry_budget", None)
                                    is None else args.retry_budget),
                      route_policy=(getattr(args, "route_policy", None)
-                                   or "cache_aware"))
+                                   or "cache_aware"),
+                     replica_procs=replica_procs,
+                     replica_hosts=replica_hosts,
+                     worker_config=worker_config,
+                     admin_token=getattr(args, "admin_token", None))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "
@@ -1293,8 +1382,31 @@ def serve(args) -> None:
         state.draining = True
         threading.Thread(target=server.shutdown, daemon=True).start()
 
+    def _hup(*_):
+        # SIGHUP = the conventional "reload" signal: run the zero-failed-
+        # requests rolling restart (drain + rebuild each replica in turn)
+        # in a background thread — a signal handler must return fast, and
+        # the restart takes seconds per replica. Router tiers only: a
+        # single supervisor has no sibling to absorb traffic, so a
+        # "rolling" restart of it would just be an outage.
+        if not state.router_mode:
+            print("🔁 SIGHUP ignored: rolling restart needs a replica "
+                  "tier (--replicas/--replica-procs)")
+            return
+        print("🔁 SIGHUP: rolling restart started")
+
+        def _run():
+            # scheduler() builds lazily on first use — a SIGHUP that
+            # arrives before any traffic must still restart, not no-op
+            state.scheduler().rolling_restart()
+
+        threading.Thread(target=_run, name="dllama-sighup-restart",
+                         daemon=True).start()
+
     if threading.current_thread() is threading.main_thread():
         signal.signal(signal.SIGTERM, _begin_drain)
+        if hasattr(signal, "SIGHUP"):
+            signal.signal(signal.SIGHUP, _hup)
     print(f"🔌 dllama-api listening on {args.host}:{args.port}")
     try:
         server.serve_forever()

@@ -233,6 +233,33 @@ def build_argparser() -> argparse.ArgumentParser:
                         "cache-aware routing idea). Session affinity "
                         "(body `session`/`user` field) applies under "
                         "every policy")
+    # process-isolated replica flags (api mode; runtime/replica_worker.py,
+    # docs/operations.md "Process-isolated replicas")
+    p.add_argument("--replica-procs", type=int, default=0, metavar="N",
+                   help="api mode, with --serve-batch: run N replicas as "
+                        "supervised OS PROCESSES (each its own "
+                        "interpreter + weights, served over the framed "
+                        "replica protocol) instead of threads — the real "
+                        "fault boundary: a segfault, OOM kill, or "
+                        "SIGKILL costs ONE replica, the router fails "
+                        "not-yet-streamed requests over to a sibling "
+                        "(token-identical for greedy), and the process "
+                        "supervisor respawns the dead worker under "
+                        "backoff with exit-code classification. "
+                        "Mutually exclusive with --replicas")
+    p.add_argument("--replica-hosts", default=None, metavar="H:P,...",
+                   help="api mode, with --serve-batch: comma-separated "
+                        "host:port list of PRE-STARTED replica workers "
+                        "(python -m distributed_llama_tpu.runtime."
+                        "replica_worker on each host) — the cross-host "
+                        "tier. No spawn supervision: each worker's "
+                        "lifetime belongs to its host's operator. "
+                        "Mutually exclusive with --replica-procs")
+    p.add_argument("--admin-token", default=None, metavar="TOKEN",
+                   help="api mode: bearer token accepted on /admin/* as "
+                        "an alternative to the loopback-only default "
+                        "(constant-time compare) — required for "
+                        "operating a remote-replica tier from off-box")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -428,6 +455,47 @@ def build_engine(args):
         seed = broadcast_seed(seed)
     sampler = Sampler(tokenizer.vocab_size, args.temperature, args.topp, seed)
     return engine, tokenizer, sampler
+
+
+class FrontDoorTemplate:
+    """The slice of the Engine surface a PROCESS-TIER api front end
+    actually reads (shape validation at startup; the handlers use the
+    router's remote shape shim per request). Built by
+    ``build_front_template`` WITHOUT loading weights: the workers own the
+    model — loading it in the parent too would hold N+1 copies locally,
+    and force a pure --replica-hosts router box to hold one at all."""
+
+    def __init__(self, spec, max_seq_len=None):
+        self.spec = spec
+        self.seq_len = min(max_seq_len or spec.seq_len, spec.seq_len)
+
+
+def build_front_template(args):
+    """model file -> (shape template, tokenizer, sampler) for the
+    process-replica front door (api --replica-procs/--replica-hosts):
+    reads only the spec header of the .m — no weight load, no Engine, no
+    KV cache. Tokenizing, routing, retry policy, and shape validation
+    are everything the parent does; the worker processes own the model
+    (runtime/replica_worker.build_supervisor_factory)."""
+    from ..io.model_file import read_spec
+    from ..quants.types import FloatType
+    from ..sampler import Sampler
+    from ..tokenizer import Tokenizer
+
+    if not args.model or not args.tokenizer:
+        sys.exit("error: --model and --tokenizer are required")
+    wft = (FloatType[args.weights_float_type.upper()]
+           if args.weights_float_type else None)
+    spec = read_spec(args.model, weights_float_type=wft)
+    print(f"⏩ {args.model}: arch={spec.arch.name} dim={spec.dim} "
+          f"layers={spec.n_layers} heads={spec.n_heads}/{spec.n_kv_heads} "
+          f"seq={spec.seq_len} (front door: spec only, workers own the "
+          "weights)")
+    tokenizer = Tokenizer.from_file(args.tokenizer)
+    seed = args.seed if args.seed is not None else int(time.time())
+    sampler = Sampler(tokenizer.vocab_size, args.temperature, args.topp,
+                      seed)
+    return FrontDoorTemplate(spec, args.max_seq_len), tokenizer, sampler
 
 
 def check_session_flags(args) -> None:

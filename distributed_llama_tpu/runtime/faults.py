@@ -32,6 +32,13 @@ wedge ONE replica deterministically while its siblings keep serving:
                         not-yet-streamed requests on a survivor)
   * ``replica_stall`` — like ``step_stall`` with the same key filter (one
                         replica wedges; only ITS watchdog may trip)
+  * ``worker_exit``   — replica-worker PROCESS token stream
+                        (runtime/replica_worker.py): the worker queries
+                        ``triggered()`` before each token frame and
+                        ``os._exit``s hard — the in-process stand-in for
+                        SIGKILL/OOM, count-deterministic and key-filtered
+                        like the other replica sites (armed via
+                        ``DLLAMA_FAULTS`` in the worker's environment)
 
 Socket-layer sites, fired inside the multihost control-plane frame codec
 (parallel/multihost.py) so two-process chaos tests can kill or stall either
@@ -74,7 +81,7 @@ import os
 import threading
 
 SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
-         "replica_raise", "replica_stall",
+         "replica_raise", "replica_stall", "worker_exit",
          "conn_refused", "recv_stall", "frame_truncate", "peer_close")
 
 
@@ -180,14 +187,19 @@ class FaultRegistry:
 
             time.sleep(ms / 1e3)
 
-    def triggered(self, site: str) -> bool:
+    def triggered(self, site: str, key: str | None = None) -> bool:
         """Count-deterministic QUERY form of ``fire()`` for sites whose
-        effect is mangling a socket rather than raising or stalling
-        (``frame_truncate``/``peer_close`` — the codec owns the socket and
-        performs the mangle itself). Consumes one invocation count."""
+        effect the CALLER performs rather than this registry raising or
+        stalling (``frame_truncate``/``peer_close`` — the codec owns the
+        socket and performs the mangle itself; ``worker_exit`` — the
+        replica worker os._exits). Consumes one invocation count, with
+        the same key filter as ``fire()``: an armed spec carrying a key
+        neither triggers nor counts for callers with a different key."""
         with self._lock:
             a = self._armed.get(site)
-            return a is not None and a.should_fire()
+            if a is None or (a.key is not None and key != a.key):
+                return False
+            return a.should_fire()
 
     def load_env(self, env=None) -> None:
         """Parse ``DLLAMA_FAULTS`` (see module docstring). Malformed specs

@@ -83,6 +83,8 @@ class ReplicaHandle:
     "can this engine serve at all", the router circuit answers "should
     traffic go here right now"."""
 
+    has_local_engine = True  # Router.exclusive may borrow our engine
+
     def __init__(self, rid: int, engine_factory, sup_kwargs: dict):
         self.id = rid
         self._factory = engine_factory
@@ -94,6 +96,11 @@ class ReplicaHandle:
         self.fails = 0
         self.open_until = 0.0   # 0 = closed; else half-open past it
         self.probing = False
+        # counter carry across restart(): the replaced supervisor's
+        # lifetime totals fold in here, so /stats aggregation never
+        # resets or double-counts across a rolling restart (the same
+        # contract SupervisorStats keeps across engine rebuilds)
+        self._carry = {k: 0 for k in _COUNTER_KEYS}
 
     # -- health / placement signals ---------------------------------------
 
@@ -149,6 +156,11 @@ class ReplicaHandle:
             # object (the closed one answers ready=False/state=closed to
             # concurrent health reads during the window — never None)
             self.sup.close(timeout=timeout)
+            # fold the dead supervisor's lifetime counters (close() is
+            # final: no writer outlives it) so /stats totals carry
+            old = self.sup.summary()
+            for k in _COUNTER_KEYS:
+                self._carry[k] += old.get(k) or 0
             self.sup = EngineSupervisor(self._factory,
                                         fault_key=f"r{self.id}",
                                         **self._sup_kwargs)
@@ -161,6 +173,11 @@ class ReplicaHandle:
     def undrain(self) -> None:
         self.draining = False
 
+    def note_routed(self, prompt: list[int]) -> None:
+        """Placement hook: in-process replicas need nothing (match_len
+        peeks the REAL radix tree); the remote handle overrides this to
+        feed its shadow index."""
+
     def close(self, timeout: float = 30.0) -> None:
         self.draining = True
         if self.sup is not None:
@@ -168,10 +185,452 @@ class ReplicaHandle:
 
     def summary(self) -> dict:
         s = self.sup.summary()
+        for k in _COUNTER_KEYS:
+            s[k] = (s.get(k) or 0) + self._carry[k]
         s["replica"] = self.id
         s["draining"] = self.draining
         s["breaker_open"] = self.open_until > 0.0
         return s
+
+
+class ShadowPrefixIndex:
+    """Router-side shadow of a PROCESS replica's radix tree: cache-aware
+    placement must survive the process boundary WITHOUT an RPC on the hot
+    path (the SGLang router keeps placement cache-aware the same way —
+    by shadowing what it routed, PAPERS.md), so the router records every
+    prompt it places on a replica at the replica's own block granularity
+    and walks this local index at pick time.
+
+    It is an approximation by design: it tracks what was ROUTED, the
+    worker's real tree tracks what was PUBLISHED and EVICTED — a stale
+    entry costs one suboptimal placement (the worker's own lookup_pin is
+    the ground truth at admission), never correctness. The monitor
+    clears it whenever the worker's supervisor generation changes
+    (``recoveries`` in the health payload — a rebuild empties the real
+    tree) and on process respawn. Entries are whole-block token paths in
+    an LRU-capped OrderedDict; eviction of a mid-path entry merely
+    shortens a future match."""
+
+    def __init__(self, block_len: int = 32, cap: int = 4096):
+        self.block_len = int(block_len)
+        self.cap = int(cap)
+        self._paths: OrderedDict[tuple, None] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def publish(self, tokens: list[int]) -> None:
+        usable = max(len(tokens) - 1, 0) // self.block_len
+        if usable <= 0:
+            return
+        with self._lock:
+            for i in range(1, usable + 1):
+                key = tuple(tokens[: i * self.block_len])
+                self._paths[key] = None
+                self._paths.move_to_end(key)
+            while len(self._paths) > self.cap:
+                self._paths.popitem(last=False)
+
+    def match_len(self, tokens: list[int]) -> int:
+        """Longest shadowed whole-block prefix, len-1-capped — the same
+        rule as PrefixCache.match_len so thread and process replicas
+        compare on one scale."""
+        usable = max(len(tokens) - 1, 0) // self.block_len
+        n = 0
+        with self._lock:
+            for i in range(1, usable + 1):
+                if tuple(tokens[: i * self.block_len]) not in self._paths:
+                    break
+                n = i
+        return n * self.block_len
+
+    def clear(self) -> None:
+        with self._lock:
+            self._paths.clear()
+
+
+class _RemoteEngineInfo:
+    """The slice of the Engine surface the HTTP handlers read off a
+    PROCESS replica — a shape/context template (``seq_len``/``batch``),
+    sourced from the worker's HELLO ack via the client cache. There is
+    no local engine to step: anything beyond the template is refused."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def _field(self, name: str) -> int:
+        v = getattr(self._client, name)
+        if v is None:
+            # no successful handshake yet (connect-mode worker not up):
+            # the handlers map EngineUnready to a retryable 503
+            raise EngineUnready("replica shape unknown (worker "
+                                "unreachable)", 1.0)
+        return v
+
+    @property
+    def seq_len(self) -> int:
+        return self._field("seq_len")
+
+    @property
+    def batch(self) -> int:
+        return self._field("batch")
+
+
+class RemoteReplicaHandle:
+    """One OUT-OF-PROCESS replica: a worker process (local-spawn mode —
+    ``WorkerProc`` + respawn supervision) or a pre-started remote worker
+    (connect mode, ``--replica-hosts``) behind the framed replica
+    protocol (runtime/replica_worker.py). Duck-types ``ReplicaHandle``
+    for the router AND the slice of the supervisor surface the router
+    reaches through ``.sup`` (``sup is self``): submit, stats, drain,
+    reset_breaker, _retry_after — so ``Router``'s placement, failover,
+    circuit, and /stats code serve thread and process replicas through
+    identical paths.
+
+    Supervision (local-spawn mode): a monitor thread watches the process
+    and a health probe (RMSG_PING — also the source of the cached
+    ``load``/``busy``/counters, so the submit hot path never RPCs for
+    health). A dead process is CLASSIFIED by exit code
+    (``classify_exit`` — ``signal:SIGKILL`` vs ``config_error`` vs
+    crash), its last-polled counters fold into a carry (totals never
+    reset or double-count across a respawn), its shadow index clears,
+    and it is respawned under exponential backoff — until
+    ``spawn_breaker`` consecutive SHORT-LIVED spawns open the per-replica
+    spawn breaker (state ``broken``; ``reset_breaker`` is the operator
+    half-open, same as every other breaker in this stack). A SIGKILLed
+    replica is routable again once the respawned worker's port handshake
+    and warmup complete — the bound the chaos tests assert."""
+
+    has_local_engine = False  # Router.exclusive must never pick us
+
+    def __init__(self, rid: int, *, proc=None, address: tuple | None = None,
+                 block_len: int = 32, shadow_cap: int = 4096,
+                 io_timeout: float = 30.0, poll_interval: float = 0.25,
+                 spawn_timeout: float = 180.0, respawn_timeout: float = 180.0,
+                 spawn_backoff_base: float = 0.2,
+                 spawn_backoff_max: float = 5.0, spawn_breaker: int = 3,
+                 min_uptime: float = 5.0):
+        from .replica_worker import WorkerClient
+        from .stats import ProcStats
+
+        assert (proc is None) != (address is None), \
+            "exactly one of proc (local spawn) or address (connect)"
+        self.id = rid
+        self.sup = self
+        self.draining = False
+        self.fails = 0
+        self.open_until = 0.0
+        self.probing = False
+        self.shadow = ShadowPrefixIndex(block_len=block_len, cap=shadow_cap)
+        self.proc_stats = ProcStats()
+        self._proc = proc
+        self._io = float(io_timeout)
+        self._poll = float(poll_interval)
+        self._respawn_timeout = float(respawn_timeout)
+        self._backoff_base = float(spawn_backoff_base)
+        self._backoff_max = float(spawn_backoff_max)
+        self._spawn_breaker = int(spawn_breaker)
+        self._min_uptime = float(min_uptime)
+        self._lock = threading.RLock()
+        self._closed = False
+        self._broken = False
+        self._spawn_fails = 0
+        self._health = {"ready": False, "state": "starting", "load": 0,
+                        "busy": False, "recoveries": 0}
+        self._last_counters = {k: 0 for k in _COUNTER_KEYS}
+        self._carry = {k: 0 for k in _COUNTER_KEYS}
+        self._last_summary: dict | None = None
+        # fold epoch: bumped by every death fold so a counter snapshot
+        # RPC'd from the dying generation can never be re-installed into
+        # the caches afterwards (it would be folded a second time on the
+        # next death — double-counting /stats totals)
+        self._fold_epoch = 0
+        if proc is not None:
+            proc.spawn()
+            try:
+                port = proc.wait_ready(timeout=spawn_timeout)
+            except BaseException:
+                # a worker that outlived its startup deadline (or a ctrl-C
+                # during the wait) must not leak the process
+                proc.stop(timeout=5.0)
+                raise
+            self.client = WorkerClient(proc.host, port,
+                                       io_timeout=io_timeout)
+        else:
+            self.client = WorkerClient(address[0], address[1],
+                                       io_timeout=io_timeout)
+        self._spawned_at = time.perf_counter()
+        self._refresh_health()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, name=f"dllama-replica-proc-r{rid}",
+            daemon=True)
+        self._monitor_thread.start()
+
+    # -- supervisor surface (sup is self) ----------------------------------
+
+    @property
+    def stats(self):
+        """Client-side latency window (timings only — counters come from
+        the worker's RSTATS, so the router's merge never double-counts)."""
+        return self.client.stats
+
+    @property
+    def prefix_cache(self):
+        return None  # match_len is overridden; the real tree is remote
+
+    @property
+    def engine(self):
+        """Shape template only (see _RemoteEngineInfo) — the worker owns
+        the real Engine on its side of the process boundary."""
+        return _RemoteEngineInfo(self.client)
+
+    def submit(self, prompt, max_tokens, sampler, eos_id=None,
+               deadline=None):
+        if self._broken or self._closed:
+            raise EngineUnready(self.state, self._retry_after())
+        if not self._health.get("ready"):
+            # cached health says no: refuse at the door without a TCP
+            # round-trip (at most one poll interval stale — a recovered
+            # worker is routable again within self._poll)
+            raise EngineUnready(self.state, self._retry_after())
+        return self.client.submit(prompt, max_tokens, sampler,
+                                  eos_id=eos_id, deadline=deadline)
+
+    def exclusive(self):
+        raise EngineUnready("remote replica: no borrowable local engine",
+                            1.0)
+
+    def _retry_after(self) -> float:
+        return 30.0 if self._broken else 1.0
+
+    def reset_breaker(self) -> None:
+        """Operator half-open for BOTH process-level breakers: the spawn
+        breaker here (the monitor resumes respawning) and the worker's
+        own engine breaker over the wire (best-effort — the worker may be
+        the very thing that is dead)."""
+        with self._lock:
+            self._spawn_fails = 0
+            self._broken = False
+            if self._health.get("state") == "broken":
+                self._health = {**self._health, "state": "resetting"}
+        self.client.reset_breaker()
+
+    # -- handle surface ----------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return (not self._closed and not self._broken
+                and bool(self._health.get("ready")))
+
+    @property
+    def state(self) -> str:
+        if self._closed:
+            return "closed"
+        if self._broken:
+            return "broken"
+        return str(self._health.get("state", "unknown"))
+
+    def load(self) -> int:
+        return int(self._health.get("load", 0))
+
+    def match_len(self, tokens: list[int]) -> int:
+        return self.shadow.match_len(tokens)
+
+    def note_routed(self, prompt: list[int]) -> None:
+        self.shadow.publish(prompt)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Router-level drain: stop routing here, then wait for the
+        worker to report idle (the ``busy`` bit of its health payload).
+        The worker's supervisor stays READY underneath — undrain
+        re-admits without a rebuild, same as the thread handle."""
+        self.draining = True
+        end = time.perf_counter() + timeout
+        while time.perf_counter() < end:
+            h = self.client.ping(timeout=2.0)
+            if h is not None and not h.get("busy"):
+                return True
+            if h is None and self._proc is not None \
+                    and self._proc.poll() is not None:
+                return True  # dead = idle; the monitor owns the respawn
+            time.sleep(0.05)
+        return False
+
+    def restart(self, timeout: float = 30.0) -> None:
+        """Rolling-restart step: RMSG_REBUILD swaps the worker's
+        supervisor in place (fresh engine + cache + empty radix tree,
+        weights shared inside the process; counters carry worker-side)
+        and blocks until the fresh one is warmed. A worker too dead to
+        answer is stopped and left to the monitor's respawn path."""
+        self.draining = True
+        try:
+            ok = self.client.rebuild(timeout=max(timeout,
+                                                 self._respawn_timeout))
+            self.shadow.clear()
+            if not ok and self._proc is not None and not self._closed:
+                self._proc.stop(timeout=5.0)  # monitor detects + respawns
+            self._refresh_health()
+        finally:
+            self.draining = False
+
+    def undrain(self) -> None:
+        self.draining = False
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._closed = True
+        self.draining = True
+        if self._proc is not None:
+            self._proc.stop(timeout=min(timeout, 10.0))
+        else:
+            # connect mode: the worker belongs to its own operator —
+            # just detach (a graceful shutdown of a shared remote worker
+            # is an ADMIN decision, not a client disconnect side effect)
+            pass
+        self.client.close()
+
+    def summary(self) -> dict:
+        with self._lock:
+            epoch = self._fold_epoch
+        live = None if self._closed else self.client.stats_summary()
+        with self._lock:  # the death fold reads/resets these caches
+            if live is not None and epoch != self._fold_epoch:
+                # the worker died between the RPC and here: the fold
+                # already absorbed these counts into _carry — installing
+                # (or reporting) the stale snapshot would double-count
+                live = None
+            if live is not None:
+                self._last_summary = live
+                self._last_counters = {k: live.get(k) or 0
+                                       for k in _COUNTER_KEYS}
+            base = dict(live or self._last_summary or {})
+            for k in _COUNTER_KEYS:
+                base[k] = (base.get(k) or 0) + self._carry[k]
+        base["state"] = self.state
+        base["replica"] = self.id
+        base["draining"] = self.draining
+        base["breaker_open"] = self.open_until > 0.0
+        proc = self.proc_stats.summary()
+        proc["mode"] = "spawn" if self._proc is not None else "connect"
+        proc["pid"] = self._proc.pid if self._proc is not None else None
+        proc["addr"] = list(self.client.addr)
+        base["proc"] = proc
+        return base
+
+    # -- supervision internals ---------------------------------------------
+
+    def _refresh_health(self) -> None:
+        with self._lock:
+            epoch = self._fold_epoch
+        payload = self.client.ping(timeout=3.0)
+        with self._lock:
+            if epoch != self._fold_epoch:
+                # the worker died while the PING was in flight: the fold
+                # owns the caches now — installing this stale payload
+                # would double-count counters on the next fold and mark
+                # a corpse ready
+                return
+            if payload is None:
+                self._health = {**self._health, "ready": False,
+                                "state": "unreachable"}
+                return
+            if payload.get("recoveries", 0) != self._health.get(
+                    "recoveries", 0):
+                # the worker's supervisor rebuilt (crash/stall recovery):
+                # its radix tree is empty — stop claiming warm prefixes
+                self.shadow.clear()
+            self._last_counters = payload.get("counters",
+                                              self._last_counters)
+            self._health = payload
+
+    def _monitor(self) -> None:
+        while not self._closed:
+            proc = self._proc
+            rc = proc.poll() if proc is not None else None
+            if proc is not None and rc is not None:
+                self._supervise_death(rc)
+                continue
+            self._refresh_health()
+            time.sleep(self._poll)
+
+    def _supervise_death(self, rc: int) -> None:
+        """Monitor-thread-only: classify and fold ONE real worker death,
+        then drive respawn attempts to success (or the spawn breaker).
+        The whole death — including every failed respawn attempt — is
+        handled inside this one call, so a reaped straggler is never
+        re-classified as a second 'exit', and failed attempts count once
+        (as ``spawn_failures``, never as worker deaths). Blocking work
+        (spawn, port-handshake wait, backoff sleeps) runs OUTSIDE
+        ``self._lock`` — /stats and reset_breaker stay responsive for the
+        full (possibly minutes-long) respawn."""
+        from .replica_worker import classify_exit
+
+        t_detect = time.perf_counter()
+        cls = classify_exit(rc)
+        with self._lock:
+            if self._closed:
+                return
+            # fold the dead process's last-polled counters: totals are a
+            # <=1-poll-interval lower bound across a SIGKILL and can
+            # never double-count (the respawned worker starts at zero;
+            # the epoch bump keeps in-flight PING/STATS snapshots of the
+            # dead generation out of the caches)
+            self._fold_epoch += 1
+            for k in _COUNTER_KEYS:
+                self._carry[k] += self._last_counters.get(k, 0)
+            self._last_counters = {k: 0 for k in _COUNTER_KEYS}
+            self._last_summary = None
+            self.shadow.clear()
+            self._health = {"ready": False, "state": f"exited:{cls}",
+                            "load": 0, "busy": False, "recoveries": 0}
+            self.proc_stats.note_exit(cls)
+            uptime = t_detect - self._spawned_at
+            # streak = consecutive SHORT-LIVED spawns: a long-healthy
+            # worker SIGKILLed by an operator/OOM respawns on the base
+            # backoff; a crash-looping one escalates into the breaker
+            self._spawn_fails = (self._spawn_fails + 1
+                                 if uptime < self._min_uptime else 0)
+            if self._spawn_fails >= self._spawn_breaker:
+                self._broken = True
+                self._health = {**self._health, "state": "broken"}
+        while not self._closed:
+            while self._broken and not self._closed:
+                time.sleep(self._poll)  # breaker open: reset_breaker
+            if self._closed:
+                return
+            time.sleep(min(self._backoff_base * (2 ** self._spawn_fails),
+                           self._backoff_max))
+            with self._lock:
+                if self._closed or self._proc.poll() is None:
+                    return  # closed, or already respawned
+            try:
+                self._proc.spawn()
+                port = self._proc.wait_ready(
+                    timeout=self._respawn_timeout)
+            except RuntimeError:
+                # reap a startup-deadline straggler, stamp the ATTEMPT
+                # (uptime must be measured from this failed spawn, not
+                # the last healthy one — otherwise a crash loop reads as
+                # "long uptime" and the breaker can never trip), and go
+                # around again
+                rc_f = self._proc.stop(timeout=5.0)
+                with self._lock:
+                    self._spawned_at = time.perf_counter()
+                    self._spawn_fails += 1
+                    self.proc_stats.note_spawn_failure(
+                        None if rc_f is None else classify_exit(rc_f))
+                    if self._spawn_fails >= self._spawn_breaker:
+                        self._broken = True
+                        self._health = {**self._health, "state": "broken"}
+                continue
+            with self._lock:
+                if self._closed:
+                    self._proc.stop(timeout=5.0)
+                    return
+                self.client.set_addr(self._proc.host, port)
+                self._spawned_at = time.perf_counter()
+                self.proc_stats.respawns += 1
+                self.proc_stats.respawn_ms.append(
+                    (time.perf_counter() - t_detect) * 1e3)
+            self._refresh_health()
+            return
 
 
 class RouterRequest:
@@ -321,10 +780,16 @@ class Router:
     def __init__(self, engine_factory, *, replicas: int = 2,
                  policy: str = "cache_aware", retry_budget: int = 1,
                  circuit_threshold: int = 3, circuit_cooldown: float = 5.0,
-                 **sup_kwargs):
+                 handle_factories=None, **sup_kwargs):
         # circuit_* name the ROUTER-level breaker so the supervisor's own
         # breaker_threshold still rides **sup_kwargs without a collision
         assert policy in POLICIES, policy
+        if handle_factories is not None:
+            # PROCESS/REMOTE tier: the caller supplies zero-arg factories
+            # building RemoteReplicaHandles (build_front_door's
+            # --replica-procs/--replica-hosts paths); engine_factory is
+            # unused — each worker process owns its own engine
+            replicas = len(handle_factories)
         assert replicas >= 1, replicas
         self.policy = policy
         self.retry_budget = max(int(retry_budget), 0)
@@ -345,9 +810,13 @@ class Router:
         # replicas 1..N-1 reuse replica 0's compilations
         self.replicas: list[ReplicaHandle] = []
         try:
-            for i in range(replicas):
-                self.replicas.append(
-                    ReplicaHandle(i, engine_factory, sup_kwargs))
+            if handle_factories is not None:
+                for f in handle_factories:
+                    self.replicas.append(f())
+            else:
+                for i in range(replicas):
+                    self.replicas.append(
+                        ReplicaHandle(i, engine_factory, sup_kwargs))
         except BaseException:
             # replica K failed to build (e.g. the K+1-th KV cache/arena
             # OOMs): close the K already-running supervisors — their step
@@ -364,8 +833,13 @@ class Router:
 
     @property
     def engine(self):
-        """Replica 0's engine — the shape/context template the handlers
-        read (seq_len etc.); never step it directly without exclusive()."""
+        """A shape/context template the handlers read (seq_len etc.);
+        never step it directly without exclusive(). Prefers a replica
+        with a LOCAL engine; an all-process tier serves the remote shape
+        shim (_RemoteEngineInfo) instead."""
+        for h in self.replicas:
+            if getattr(h, "has_local_engine", True):
+                return h.sup.engine
         return self.replicas[0].sup.engine
 
     @property
@@ -421,10 +895,15 @@ class Router:
     def exclusive(self):
         """Borrow ONE routable replica's engine (Scheduler.exclusive via
         its supervisor) — the legacy whole-batch endpoint's path. Lowest
-        routable id wins so repeat borrows hit a warm engine."""
+        routable id wins so repeat borrows hit a warm engine. PROCESS
+        replicas are never borrowable (their engine lives across the
+        process boundary) — an all-process tier refuses with a
+        structured 503 instead."""
         now = time.perf_counter()
         with self._lock:
-            targets = [h for h in self.replicas if self._routable(h, now)]
+            targets = [h for h in self.replicas
+                       if self._routable(h, now)
+                       and getattr(h, "has_local_engine", True)]
         if not targets:
             raise EngineUnready("no_replica", 1.0)
         return targets[0].sup.exclusive()
@@ -634,6 +1113,11 @@ class Router:
                 if probe:
                     self._release_probe(h)
                 raise
+            # feed the placement signal for FUTURE picks: in-process
+            # replicas no-op (match_len peeks their real radix tree); a
+            # process replica records the routed prompt in its shadow
+            # index (cache-aware placement without an RPC)
+            h.note_routed(req._prompt)
             with self._lock:
                 req._inner, req._handle = inner, h
                 req._probe = probe
@@ -683,15 +1167,73 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                      stall_timeout: float = 0.0, prefix_cache: bool = False,
                      prefix_blocks: int = 0, prefix_block_len: int = 32,
                      replicas: int = 1, retry_budget: int = 1,
-                     route_policy: str = "cache_aware"):
-    """The ONE constructor of the serving front door, shared by 1- and
-    N-replica deployments (the engine-owner logic that used to live in
-    apps/api_server.ApiState.scheduler): builds the per-replica engine
-    factory over ``engine``'s weights (param device buffers SHARED — a
-    replica costs one more KV cache + prefix arena, never another copy of
-    the model) and returns an ``EngineSupervisor`` (replicas == 1, the
-    exact PR-3 object) or a ``Router`` over N of them."""
+                     route_policy: str = "cache_aware",
+                     replica_procs: int = 0, replica_hosts=None,
+                     worker_config: dict | None = None,
+                     workdir: str | None = None,
+                     worker_io_timeout: float = 30.0,
+                     spawn_timeout: float = 300.0):
+    """The ONE constructor of the serving front door, shared by every
+    deployment shape (the engine-owner logic that used to live in
+    apps/api_server.ApiState.scheduler):
+
+      * replicas == 1 (default): an ``EngineSupervisor`` — the exact
+        PR-3 object.
+      * replicas > 1: a ``Router`` over N THREAD replicas, each its own
+        supervisor over ``engine``'s SHARED weight buffers.
+      * replica_procs > 0: a ``Router`` over N locally-SPAWNED worker
+        PROCESSES (runtime/replica_worker.py), each loading its own
+        weights from ``worker_config`` — the real fault boundary: a
+        SIGKILL/OOM/segfault costs one process, and the handle respawns
+        it under supervision.
+      * replica_hosts: a ``Router`` over pre-started workers at
+        ``[(host, port), ...]`` — the cross-host tier (no spawn
+        supervision; each host's operator owns its worker's lifetime).
+
+    The HTTP handlers serve all four through the identical duck-typed
+    surface."""
     from .engine import Engine
+
+    if replica_procs or replica_hosts:
+        import os
+        import tempfile
+
+        from .replica_worker import WorkerProc
+
+        factories = []
+        if replica_procs:
+            assert worker_config is not None, \
+                "replica_procs needs a worker_config dict"
+            workdir = workdir or tempfile.mkdtemp(prefix="dllama-replicas-")
+            os.makedirs(workdir, exist_ok=True)
+            for i in range(int(replica_procs)):
+                cfg = dict(worker_config)
+                # replica identity at the key-filtered fault sites rides
+                # into the worker so DLLAMA_FAULTS key=rK follows replica
+                # K across respawns, same as the thread tier
+                cfg["fault_key"] = f"r{i}"
+
+                def make(i=i, cfg=cfg):
+                    proc = WorkerProc(i, cfg, workdir=workdir,
+                                      io_timeout=worker_io_timeout)
+                    return RemoteReplicaHandle(
+                        i, proc=proc, block_len=prefix_block_len,
+                        io_timeout=worker_io_timeout,
+                        spawn_timeout=spawn_timeout,
+                        respawn_timeout=spawn_timeout)
+                factories.append(make)
+        else:
+            for i, (host, port) in enumerate(replica_hosts):
+                def make(i=i, host=host, port=port):
+                    return RemoteReplicaHandle(
+                        i, address=(host, port),
+                        block_len=prefix_block_len,
+                        io_timeout=worker_io_timeout)
+                factories.append(make)
+        return Router(None, policy=route_policy,
+                      retry_budget=retry_budget,
+                      handle_factories=factories,
+                      request_deadline=request_deadline or None)
 
     def engine_factory():
         return Engine(engine.spec, engine.params, batch=serve_batch,

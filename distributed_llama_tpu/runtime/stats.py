@@ -261,6 +261,51 @@ class RouterStats:
 
 
 @dataclasses.dataclass
+class ProcStats:
+    """Process-supervision counters owned by
+    runtime/router.RemoteReplicaHandle (local-spawn mode): every worker
+    exit is CLASSIFIED (``classify_exit`` — ``signal:SIGKILL``,
+    ``config_error``, ``fault_exit``, ...) and the respawn-to-routable
+    latency distribution is what the process-kill chaos tests and the
+    ``BENCH_ROUTER=1`` process row assert their bound against. Surfaced
+    as the ``proc`` block of each replica's /stats summary."""
+
+    respawns: int = 0         # successful respawn-to-routable cycles
+    spawn_failures: int = 0   # spawn attempts that died/hung pre-ready
+    exits: int = 0            # deaths of READY (post-handshake) workers
+
+    def __post_init__(self):
+        from collections import deque
+
+        # death-detected -> port-handshake-complete (warmed) latency
+        self.respawn_ms = deque(maxlen=1000)
+        # classes of ALL process deaths — ready-worker exits AND failed
+        # spawn attempts (a crash-looping `config_error` shows up here
+        # even though it never got far enough to count as an `exit`)
+        self.exit_classes: dict[str, int] = {}
+
+    def note_exit(self, cls: str) -> None:
+        self.exits += 1
+        self.exit_classes[cls] = self.exit_classes.get(cls, 0) + 1
+
+    def note_spawn_failure(self, cls: str | None) -> None:
+        self.spawn_failures += 1
+        if cls is not None:
+            self.exit_classes[cls] = self.exit_classes.get(cls, 0) + 1
+
+    def summary(self) -> dict:
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        return {
+            "exits": self.exits,
+            "exit_classes": dict(self.exit_classes),
+            "respawns": self.respawns,
+            "spawn_failures": self.spawn_failures,
+            "respawn_p50_ms": rnd(percentile(list(self.respawn_ms), 50)),
+            "respawn_p99_ms": rnd(percentile(list(self.respawn_ms), 99)),
+        }
+
+
+@dataclasses.dataclass
 class SupervisorStats:
     """Resilience counters owned by runtime/resilience.EngineSupervisor —
     they survive scheduler rebuilds (each recovery mints a fresh

@@ -1308,6 +1308,55 @@ def test_api_admin_on_single_replica_and_legacy(api_server):
     assert "--serve-batch" in json.loads(resp.read())["error"]
 
 
+def test_admin_authorized_token_paths():
+    """ISSUE 7 satellite (unit): loopback always passes; off-loopback
+    needs an exact --admin-token bearer (constant-time compare) — no
+    token configured means off-box is always refused, and a configured
+    token never opens the door to a wrong or missing header."""
+    from types import SimpleNamespace
+
+    from distributed_llama_tpu.apps.api_server import _admin_authorized
+
+    s = SimpleNamespace(admin_token="s3cret-tok")
+    assert _admin_authorized(s, "127.0.0.1", None)          # loopback
+    assert _admin_authorized(s, "::1", "Bearer wrong")      # still loopback
+    assert _admin_authorized(s, "10.0.0.1", "Bearer s3cret-tok")
+    assert not _admin_authorized(s, "10.0.0.1", None)
+    assert not _admin_authorized(s, "10.0.0.1", "Bearer nope")
+    assert not _admin_authorized(s, "10.0.0.1", "s3cret-tok")  # no scheme
+    assert not _admin_authorized(s, "10.0.0.1", "bearer s3cret-tok")
+    no_tok = SimpleNamespace(admin_token=None)
+    assert not _admin_authorized(no_tok, "10.0.0.1", "Bearer s3cret-tok")
+    assert _admin_authorized(no_tok, "127.0.0.1", None)
+
+
+def test_api_admin_token_403_and_200_off_loopback(sched_api_server,
+                                                  monkeypatch):
+    """ISSUE 7 satellite (HTTP): with the caller simulated off-loopback,
+    /admin/* is 403 without (or with a wrong) bearer and 200 with the
+    configured --admin-token — the operator path for remote-replica
+    deployments where loopback-only was an outage."""
+    import distributed_llama_tpu.apps.api_server as api_mod
+
+    (host, port), state = sched_api_server
+    monkeypatch.setattr(api_mod, "_is_loopback", lambda addr: False)
+    monkeypatch.setattr(state, "admin_token", "tok-123")
+
+    def post(headers):
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/admin/reset_breaker", json.dumps({}),
+                     {"Content-Type": "application/json", **headers})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    status, body = post({})
+    assert status == 403 and "admin-token" in body["error"]
+    status, _ = post({"Authorization": "Bearer wrong"})
+    assert status == 403
+    status, _ = post({"Authorization": "Bearer tok-123"})
+    assert status == 200
+
+
 def test_api_healthz_readyz_all_modes_never_404(api_server,
                                                 sched_api_server,
                                                 router_api_server):

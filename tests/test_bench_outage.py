@@ -157,6 +157,7 @@ def test_router_row_emits_valid_json():
     greedy token-identical across all three serves."""
     r = _run_bench({
         "BENCH_ROUTER": "1",
+        "BENCH_ROUTER_PROCS": "0",   # thread row only (procs row below)
         "BENCH_ROUTER_REQUESTS": "10",
         "BENCH_ROUTER_GROUPS": "3",
         "BENCH_ROUTER_SYS": "32",
@@ -188,6 +189,51 @@ def test_router_row_emits_valid_json():
     assert chaos["availability_pct"] is not None
     assert chaos["availability_pct"] >= 99.0, chaos  # readiness held
     assert v["token_parity"] is True
+    json.dumps(v)  # the row round-trips as machine-readable JSON
+
+
+def test_router_procs_row_emits_valid_json():
+    """BENCH_ROUTER=1 also grows the PROCESS-mode row
+    (bench._router_procs_row; BENCH_ROUTER_PROCS=only selects just it):
+    two real replica worker OS processes behind the framed protocol, one
+    delivered a genuine SIGKILL mid-Poisson-trace. The ISSUE-7 acceptance
+    bars ride the assertions: ZERO unstreamed request failures (failover
+    to the sibling), service availability held by the survivor, the
+    supervisor classified the SIGKILL and respawned the worker to
+    routable within the bound, and every completed serve of the same
+    prompt is greedy token-identical — including post-respawn."""
+    r = _run_bench({
+        "BENCH_ROUTER": "1",
+        "BENCH_ROUTER_PROCS": "only",
+        "BENCH_PROCS_REQUESTS": "6",
+        "BENCH_PROCS_TOKENS": "4",
+        "BENCH_PROCS_KILL_AFTER": "3",
+        "BENCH_PROCS_STEP_MS": "40",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    rows = [v for v in row.get("variants", [])
+            if "router_procs" in v["metric"]]
+    assert len(rows) == 1, row
+    v = rows[0]
+    assert v["unit"] == "ms" and v["mode"] == "process"
+    # the kill really happened and was classified as a real SIGKILL
+    assert v["exit_classes"].get("signal:SIGKILL") == 1, v
+    assert v["respawns"] == 1, v
+    # supervised respawn-to-routable within the configured bound
+    assert v["within_bound"] is True, v
+    assert v["value"] is not None and v["value"] > 0
+    assert v["respawn_p50_ms"] is not None and v["respawn_p50_ms"] > 0
+    # zero unstreamed failures; mid-stream casualties only, structured
+    assert v["unstreamed_failures"] == 0, v
+    assert v["completed"] + v["midstream_failures"] == 6 + 2, v
+    # the surviving replica kept the service available throughout
+    assert v["availability_pct"] is not None
+    assert v["availability_pct"] >= 99.0, v
+    assert v["token_parity"] is True, v
     json.dumps(v)  # the row round-trips as machine-readable JSON
 
 

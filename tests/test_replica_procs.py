@@ -1,0 +1,444 @@
+"""Process-isolated replicas (runtime/replica_worker.py + the
+RemoteReplicaHandle process supervision in runtime/router.py).
+
+The chaos contract under test is the ISSUE 7 acceptance bar — the
+strongest kill the repo can deliver, upgraded from "injected exception"
+to a REAL ``SIGKILL -9`` of a live replica OS process mid-stream:
+
+  * zero unstreamed request failures — a request whose worker dies
+    before its first token fails over to a sibling replica within the
+    retry budget and returns greedy tokens BIT-IDENTICAL to the
+    single-engine oracle (the connection EOF surfaces as a structured
+    RETRYABLE ``replica_lost`` frame, feeding the PR-6 failover
+    machinery unchanged);
+  * a request that already streamed tokens gets the structured
+    NON-retryable frame (never a silent replay);
+  * the process supervisor classifies the death (``signal:SIGKILL``),
+    respawns the worker under backoff, and the replica is ROUTABLE
+    again within the configured bound;
+  * /stats counter totals carry across the respawn — never reset,
+    never double-counted (the ``SupervisorStats`` contract, now across
+    a process boundary);
+  * a crash-looping worker (spawns that die young) trips the per-replica
+    spawn breaker instead of respawning forever; ``reset_breaker`` is
+    the operator half-open.
+
+Every worker is a REAL subprocess running single-process CPU JAX over a
+deterministic ``test_spec`` (same spec/seed as the in-test oracle, so
+params are bit-identical across the process boundary) — the same
+subprocess discipline as tests/test_cluster_chaos.py, so these run
+wherever the cluster chaos tests do (the CI ``chaos`` job; the main
+matrix ignores them).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from distributed_llama_tpu.models import ArchType, HiddenAct, ModelSpec
+from distributed_llama_tpu.models.params import load_params, random_tensors
+from distributed_llama_tpu.runtime.engine import Engine
+from distributed_llama_tpu.runtime.replica_worker import (EXIT_WORKER_FAULT,
+                                                          WorkerClient,
+                                                          WorkerProc,
+                                                          classify_exit)
+from distributed_llama_tpu.runtime.resilience import EngineUnready
+from distributed_llama_tpu.runtime.router import RemoteReplicaHandle, Router
+from distributed_llama_tpu.runtime.scheduler import (PromptTooLong,
+                                                     RequestError)
+from distributed_llama_tpu.sampler import Sampler
+
+SEQ = 64
+SPEC_FIELDS = dict(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                   n_kv_heads=2, vocab_size=128, seq_len=SEQ)
+SEED, SCALE = 3, 0.05
+
+# the worker config every test ships: deterministic synthetic weights
+# (same spec/seed/scale as the oracle below — bit-identical params in
+# both processes), f32 so greedy parity compares bit-exactly
+CFG = {"test_spec": SPEC_FIELDS, "seed": SEED, "scale": SCALE,
+       "compute_dtype": "f32", "batch": 2,
+       "serve": {"stall_timeout": 60.0}}
+
+# the worker subprocess environment: CPU jax, plus the parent's XLA
+# compilation cache so repeat spawns skip the compile cost
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(
+        os.path.expanduser("~"), ".cache", "dllama_tpu_xla"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1.0",
+}
+
+SPAWN_TIMEOUT = 120.0   # worker startup bound (import + build + warmup)
+RESPAWN_BOUND = 60.0    # death -> routable-again acceptance bound
+
+
+@pytest.fixture(scope="module")
+def oracle_bits():
+    spec = ModelSpec(arch=ArchType.LLAMA, hidden_act=HiddenAct.SILU,
+                     **SPEC_FIELDS)
+    host = random_tensors(spec, seed=SEED, scale=SCALE)
+    params = load_params(spec, host, mode="dense", dtype=jnp.float32)
+    return spec, params
+
+
+def _greedy():
+    return Sampler(SPEC_FIELDS["vocab_size"], temperature=0.0, topp=0.9,
+                   seed=1)
+
+
+def _oracle(oracle_bits, prompt, max_tokens):
+    spec, params = oracle_bits
+    eng = Engine(spec, params, batch=1, compute_dtype=jnp.float32,
+                 cache_dtype=jnp.float32)
+    return eng.generate(prompt, max_tokens, _greedy()).tokens
+
+
+def _proc(rid, workdir, faults=""):
+    return WorkerProc(rid, dict(CFG, fault_key=f"r{rid}"),
+                      workdir=str(workdir), env=WORKER_ENV,
+                      faults=faults or None)
+
+
+def _handle(rid, workdir, faults="", **kw):
+    kw.setdefault("poll_interval", 0.1)
+    kw.setdefault("spawn_backoff_base", 0.05)
+    kw.setdefault("spawn_timeout", SPAWN_TIMEOUT)
+    kw.setdefault("respawn_timeout", SPAWN_TIMEOUT)
+    return RemoteReplicaHandle(rid, proc=_proc(rid, workdir, faults), **kw)
+
+
+def _wait(pred, timeout=RESPAWN_BOUND, poll=0.02):
+    end = time.perf_counter() + timeout
+    while time.perf_counter() < end:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _two_replica_router(mk, **router_kw):
+    """Spawn two worker handles CONCURRENTLY (construction blocks on the
+    port handshake — import + build + warmup; the shared compilation
+    cache makes the second compile-free but not import-free), then hand
+    Router prebuilt handles. Keeps the two-replica chaos tests inside
+    the fast tier's time budget."""
+    handles = [None, None]
+
+    def build(i):
+        handles[i] = mk(i)
+
+    threads = [threading.Thread(target=build, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if not all(h is not None for h in handles):
+        for h in handles:
+            if h is not None:
+                h.close()  # don't orphan the sibling that DID come up
+        raise AssertionError("worker spawn failed")
+    return Router(None, handle_factories=[lambda: handles[0],
+                                          lambda: handles[1]], **router_kw)
+
+
+# -- the framed protocol, one worker --------------------------------------
+
+
+def test_worker_roundtrip_parity_refusals_and_admin_verbs(tmp_path,
+                                                          oracle_bits):
+    """One worker process over the framed codec: greedy tokens are
+    bit-identical to the in-process oracle (the sampler spec rides the
+    submit frame and is reconstructed worker-side), door refusals
+    re-raise the SAME exception types the in-process supervisor uses,
+    RMSG_REBUILD swaps the supervisor while counters carry, and a
+    graceful shutdown is exit 0 / ``clean``."""
+    proc = _proc(0, tmp_path)
+    proc.spawn()
+    try:
+        port = proc.wait_ready(timeout=SPAWN_TIMEOUT)
+        client = WorkerClient("127.0.0.1", port)
+        h = client.ping()
+        assert h is not None and h["ready"] and h["state"] == "ready"
+
+        p = [1, 9, 23, 54, 7]
+        rs = client.submit(p, 6, _greedy())
+        assert list(rs.tokens(timeout=60.0)) == _oracle(oracle_bits, p, 6)
+        assert rs.finish_reason == "length"
+        # the HELLO ack cached the shape template (the handlers' slice)
+        assert client.batch == 2 and client.seq_len == SEQ
+
+        # door refusal types survive the wire
+        with pytest.raises(PromptTooLong):
+            client.submit(list(range(1, SEQ + 2)), 2, _greedy())
+
+        # rolling-restart verb: fresh supervisor, counters carry
+        before = client.stats_summary()
+        assert before["requests_finished"] == 1
+        assert client.rebuild(timeout=SPAWN_TIMEOUT)
+        after = client.stats_summary()
+        assert after["requests_finished"] == 1      # carried, not reset
+        assert after["tokens_out"] == before["tokens_out"]
+        rs = client.submit(p, 6, _greedy())          # and it still serves
+        assert list(rs.tokens(timeout=60.0)) == _oracle(oracle_bits, p, 6)
+        assert client.stats_summary()["requests_finished"] == 2
+
+        assert client.shutdown()
+        rc = proc.stop(timeout=20.0)
+        assert rc == 0 and classify_exit(rc) == "clean"
+    finally:
+        proc.stop(timeout=10.0)
+
+
+def test_worker_exit_fault_is_retryable_eof_pre_token(tmp_path):
+    """The ``worker_exit`` site (the in-process SIGKILL/OOM stand-in):
+    armed with key=r0 in the worker's OWN environment, the worker
+    os._exits immediately before its first token frame — the client
+    sees a mid-request EOF with ZERO tokens streamed and raises the
+    structured RETRYABLE ``replica_lost`` frame (exactly what the
+    router's failover machinery consumes), and the corpse classifies as
+    ``fault_exit``."""
+    proc = _proc(0, tmp_path, faults="worker_exit:key=r0")
+    proc.spawn()
+    try:
+        port = proc.wait_ready(timeout=SPAWN_TIMEOUT)
+        client = WorkerClient("127.0.0.1", port)
+        rs = client.submit([1, 9, 23], 4, _greedy())
+        got = []
+        with pytest.raises(RequestError) as ei:
+            for t in rs.tokens(timeout=60.0):
+                got.append(t)
+        assert got == []                      # pre-first-token, always
+        assert ei.value.code == "replica_lost"
+        assert ei.value.retryable is True
+        assert _wait(lambda: proc.poll() is not None, 30.0)
+        assert proc.poll() == EXIT_WORKER_FAULT
+        assert classify_exit(proc.poll()) == "fault_exit"
+    finally:
+        proc.stop(timeout=10.0)
+
+
+# -- the acceptance chaos test: real SIGKILL mid-stream --------------------
+
+
+def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
+        tmp_path, oracle_bits):
+    """ISSUE 7 acceptance: ``kill -9`` a live replica worker process
+    while it serves a mid-stream request AND holds a not-yet-streamed
+    one. The streamed request gets the structured NON-retryable frame
+    (partial output is never silently replayed); the unstreamed one
+    fails over to the sibling replica and returns BIT-IDENTICAL greedy
+    tokens; the service stays ready throughout; and the supervisor
+    classifies the SIGKILL and respawns the worker to routable within
+    the bound."""
+    # worker-side slow_step paces decode (80 ms/step) so the kill
+    # provably lands while streams are in flight
+    router = _two_replica_router(
+        lambda i: _handle(i, tmp_path, faults="slow_step:times=0;ms=80"),
+        policy="round_robin", retry_budget=1)
+    h0, h1 = router.replicas
+    p = [1, 9, 23, 54, 7]
+    want6 = _oracle(oracle_bits, p, 6)
+    ready_gaps = []
+    sampling = threading.Event()
+    sampling.set()
+
+    def sample_ready():
+        while sampling.is_set():
+            if not router.ready:
+                ready_gaps.append(time.perf_counter())
+            time.sleep(0.005)
+
+    try:
+        samp = threading.Thread(target=sample_ready, daemon=True)
+        samp.start()
+        # round_robin placement is deterministic: A -> r0, B -> r1,
+        # C -> r0
+        req_a = router.submit(p, 6, _greedy())
+        req_b = router.submit(p, 6, _greedy())
+        it_a = req_a.tokens(timeout=120.0)
+        got_a = [next(it_a)]              # A is LIVE mid-stream on r0...
+        # ...and C joins r0 only NOW, after A's first token: its own
+        # first token is at least one paced prefill + one paced decode
+        # step away (>= 160 ms), so the kill provably lands before C
+        # streams anything
+        req_c = router.submit(p, 6, _greedy())
+        assert (req_a.replica_id, req_b.replica_id,
+                req_c.replica_id) == (0, 1, 0)
+        t_kill = time.perf_counter()
+        os.kill(h0._proc.proc.pid, signal.SIGKILL)
+
+        # A: already streamed -> structured NON-retryable frame
+        with pytest.raises(RequestError) as ei:
+            for t in it_a:
+                got_a.append(t)
+        assert ei.value.retryable is False
+        assert "already streamed" in str(ei.value)
+        assert len(got_a) >= 1
+        assert got_a == want6[:len(got_a)]  # the partial stream was real
+
+        # C: zero tokens streamed -> bounded failover to r1, parity
+        got_c = list(req_c.tokens(timeout=120.0))
+        assert got_c == want6, "failover lost greedy parity"
+        assert req_c.retries == 1 and req_c.replica_id == 1
+
+        # B (on the surviving replica) never noticed
+        assert list(req_b.tokens(timeout=120.0)) == want6
+
+        # supervised respawn: classified, counted, routable within bound
+        assert _wait(lambda: h0.ready, RESPAWN_BOUND), \
+            f"r0 not routable {RESPAWN_BOUND}s after SIGKILL"
+        t_routable = time.perf_counter() - t_kill
+        assert t_routable < RESPAWN_BOUND
+        ps = h0.proc_stats.summary()
+        assert ps["exit_classes"].get("signal:SIGKILL") == 1
+        assert ps["respawns"] == 1
+        assert ps["respawn_p50_ms"] is not None
+
+        # the respawned worker SERVES (fresh process, same weights)
+        req_d = router.submit(p, 4, _greedy())
+        assert list(req_d.tokens(timeout=120.0)) == want6[:4]
+
+        # the single-replica outage was invisible at the service level
+        assert not ready_gaps, f"router went unready at {ready_gaps}"
+        assert router.stats.midstream_failures == 1
+        assert router.stats.retries == 1
+        assert router.stats.failovers_ok == 1
+    finally:
+        sampling.clear()
+        router.close()
+
+
+# -- /stats aggregation across a respawn (satellite) -----------------------
+
+
+def test_stats_totals_carry_across_respawn_no_reset_no_double_count(
+        tmp_path, oracle_bits):
+    """Counter totals in the router's /stats aggregation must behave
+    across a worker respawn exactly like SupervisorStats does across an
+    engine rebuild: carried, never reset, never double-counted. The
+    parent folds the dead process's last-polled counters into a carry;
+    with the monitor given one quiet poll interval before the kill, the
+    fold is exact."""
+    router = Router(None, policy="least_loaded", retry_budget=1,
+                    handle_factories=[lambda: _handle(0, tmp_path)])
+    h0 = router.replicas[0]
+    p = [2, 40, 77, 5]
+    try:
+        for _ in range(2):
+            req = router.submit(p, 3, _greedy())
+            assert list(req.tokens(timeout=120.0)) == _oracle(
+                oracle_bits, p, 3)
+        # let the monitor's PONG poll capture the finished counters so
+        # the carry across the kill is exact, not a lower bound
+        assert _wait(lambda: h0._last_counters["requests_finished"] == 2,
+                     10.0)
+        s1 = router.summary()
+        assert s1["requests_finished"] == 2
+        assert s1["tokens_out"] == 6
+
+        os.kill(h0._proc.proc.pid, signal.SIGKILL)
+        assert _wait(lambda: h0.proc_stats.respawns == 1, RESPAWN_BOUND)
+        # mid-restart reads never went backwards or forward-jumped
+        s2 = router.summary()
+        assert s2["requests_finished"] == 2      # carried, not reset
+        assert s2["tokens_out"] == 6             # and not double-counted
+
+        assert _wait(lambda: h0.ready, RESPAWN_BOUND)
+        req = router.submit(p, 3, _greedy())
+        assert list(req.tokens(timeout=120.0)) == _oracle(
+            oracle_bits, p, 3)
+        s3 = router.summary()
+        assert s3["requests_finished"] == 3      # old 2 + new 1
+        assert s3["tokens_out"] == 9
+        reps = s3["replicas"]
+        assert reps[0]["proc"]["mode"] == "spawn"
+        assert reps[0]["proc"]["exit_classes"].get("signal:SIGKILL") == 1
+    finally:
+        router.close()
+
+
+# -- spawn breaker on a crash loop ----------------------------------------
+
+
+def test_crash_loop_trips_spawn_breaker_and_reset_recovers(tmp_path):
+    """A worker whose respawns keep dying young (here: config file
+    corrupted after a healthy start -> every respawn is a fast exit 2
+    ``config_error``) must trip the per-replica spawn breaker instead of
+    respawning forever; ``reset_breaker`` after restoring the config is
+    the operator half-open that resumes supervision."""
+    h0 = _handle(0, tmp_path, min_uptime=5.0, spawn_breaker=3,
+                 spawn_backoff_max=0.2)
+    try:
+        assert h0.ready
+        good = open(h0._proc.config_path).read()
+        with open(h0._proc.config_path, "w") as f:
+            f.write("{not json")
+        os.kill(h0._proc.proc.pid, signal.SIGKILL)
+        assert _wait(lambda: h0.state == "broken", RESPAWN_BOUND), \
+            f"breaker never tripped (state {h0.state})"
+        assert not h0.ready
+        with pytest.raises(EngineUnready):
+            h0.submit([1, 2, 3], 2, _greedy())
+        assert h0.proc_stats.spawn_failures >= 1
+        assert h0.proc_stats.exit_classes.get("config_error", 0) >= 1
+
+        # operator half-open: fix the config, reset, supervision resumes
+        with open(h0._proc.config_path, "w") as f:
+            f.write(good)
+        h0.reset_breaker()
+        assert _wait(lambda: h0.ready, RESPAWN_BOUND), \
+            "reset_breaker did not resume respawning"
+        rs = h0.submit([1, 9, 23], 2, _greedy())
+        assert len(list(rs.tokens(timeout=60.0))) == 2
+    finally:
+        h0.close()
+
+
+# -- shadow prefix index placement (process-mode cache awareness) ----------
+
+
+def test_shadow_index_routes_cache_aware_and_clears_on_respawn(
+        tmp_path, oracle_bits):
+    """Cache-aware placement across the process boundary: the router's
+    shadow radix index records what it ROUTED (no RPC on the hot path),
+    so a repeat prompt is placed on the replica that already served its
+    prefix; a worker death clears that replica's shadow (the respawned
+    process holds an empty real tree)."""
+    cfg_pc = dict(CFG, prefix_cache=True, prefix_blocks=32,
+                  prefix_block_len=4)
+
+    def mk(i):
+        proc = WorkerProc(i, dict(cfg_pc, fault_key=f"r{i}"),
+                          workdir=str(tmp_path), env=WORKER_ENV)
+        return RemoteReplicaHandle(i, proc=proc, block_len=4,
+                                   poll_interval=0.1,
+                                   spawn_backoff_base=0.05,
+                                   spawn_timeout=SPAWN_TIMEOUT,
+                                   respawn_timeout=SPAWN_TIMEOUT)
+
+    router = _two_replica_router(mk, policy="cache_aware", retry_budget=1)
+    h0 = router.replicas[0]
+    p = [1, 9, 23, 54, 7, 11, 40, 3, 15]   # two whole 4-token blocks
+    try:
+        want = _oracle(oracle_bits, p, 3)
+        r1 = router.submit(p, 3, _greedy())
+        assert list(r1.tokens(timeout=120.0)) == want
+        assert r1.replica_id == 0           # idle tie-break: lowest id
+        assert h0.match_len(p) >= 4         # the shadow recorded it
+        # repeat prompt: placed by SHADOW match, not fallback
+        r2 = router.submit(p, 3, _greedy())
+        assert list(r2.tokens(timeout=120.0)) == want
+        assert r2.replica_id == 0
+        assert router.stats.routed_cache_hit >= 1
+
+        os.kill(h0._proc.proc.pid, signal.SIGKILL)
+        assert _wait(lambda: h0.proc_stats.respawns == 1, RESPAWN_BOUND)
+        assert h0.match_len(p) == 0         # shadow cleared with the corpse
+    finally:
+        router.close()

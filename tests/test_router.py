@@ -569,6 +569,41 @@ def test_no_routable_replica_is_structured_rejection(tiny):
         router.close()
 
 
+def test_stats_totals_carry_across_replica_restart(tiny):
+    """/stats aggregation while a replica restarts: counter totals must
+    neither reset nor double-count across the rebuild (the handle folds
+    the dead supervisor's lifetime totals into a carry — the same
+    contract SupervisorStats keeps across engine rebuilds, and the same
+    bar the process tier pins across a SIGKILL respawn in
+    tests/test_replica_procs.py)."""
+    spec, params = tiny
+    router = _router(tiny)
+    try:
+        p = [1, 9, 23]
+        for _ in range(3):
+            req = router.submit(p, 2, _greedy(spec))
+            assert list(req.tokens(timeout=60.0)) == _oracle(
+                spec, params, p, 2)
+        s1 = router.summary()
+        assert s1["requests_finished"] == 3 and s1["tokens_out"] == 6
+        # restart replica 0 (it served at least one of the three —
+        # cache-aware placement routed the repeats to it)
+        assert router.drain_replica(0, timeout=30.0)
+        router.restart_replica(0, timeout=60.0)
+        s2 = router.summary()
+        assert s2["requests_finished"] == 3      # carried, not reset
+        assert s2["tokens_out"] == 6             # and not double-counted
+        r0 = next(r for r in s2["replicas"] if r["replica"] == 0)
+        assert r0["state"] == "ready" and not r0["draining"]
+        req = router.submit(p, 2, _greedy(spec))
+        assert list(req.tokens(timeout=60.0)) == _oracle(
+            spec, params, p, 2)
+        s3 = router.summary()
+        assert s3["requests_finished"] == 4 and s3["tokens_out"] == 8
+    finally:
+        router.close()
+
+
 def test_router_summary_aggregates_and_reports_replicas(tiny):
     spec, params = tiny
     router = _router(tiny)
