@@ -66,6 +66,7 @@ import numpy as np
 from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec
 from distributed_llama_tpu.quants.jax_codec import QuantizedTensor
 from distributed_llama_tpu.runtime.engine import Engine
+from distributed_llama_tpu.runtime.trace import TRACER
 
 BASELINE_MS_PER_TOKEN = 101.81  # ref README.md:88 — Llama 2 7B, 1x GCP c3d-highcpu-30
 BASELINE_8B_MS_PER_TOKEN = 564.31  # ref README.md:61 — Llama 3 8B, best 1-node (RasPi 5)
@@ -221,7 +222,36 @@ def _measure_decode(engine, n_tokens: int, fill: int, repeats: int) -> float:
         engine.pos = fill
         _, d = engine.decode_greedy_device(first_token=1, n_tokens=n_tokens)
         dt = d if dt is None else min(dt, d)
+        if TRACER.enabled:
+            # the on-device loop has no per-step host boundary, so the
+            # timeline sample is the run's MEAN ms/token at this batch
+            # composition — one sample per measured run, comparable with
+            # the scheduler rows' per-iteration records
+            TRACER.step(decode_rows=engine.batch, prefill_rows=0, chunk=0,
+                        queue_depth=0, wall_ms=d / n_tokens * 1e3)
     return dt / n_tokens * 1e3
+
+
+def _with_step_timeline(row_fn, *args, **kwargs) -> dict:
+    """Run one bench row with the flight recorder on and attach the
+    per-batch-composition step-ms summary (the ISSUE-9 satellite: every
+    row carries the raw measurement ROADMAP item 1's knee search mines).
+    Rows that drive the slot scheduler get real per-iteration
+    compositions; rows measuring the on-device decode loop get per-run
+    mean samples (see _measure_decode); rows with neither (the cluster
+    control-plane row) carry an empty block. The recorder is reset per
+    row so compositions from different models/batches never mix."""
+    TRACER.reset()
+    # decode_every huge: the serving rows only need STEP records here —
+    # span events would grow the ring without changing the block
+    TRACER.configure(capacity=4096, decode_every=1 << 30)
+    try:
+        row = row_fn(*args, **kwargs)
+    finally:
+        timeline = TRACER.steps.summary_json()
+        TRACER.reset()
+    row["step_timeline"] = timeline
+    return row
 
 
 def _decode_row(metric: str, spec: ModelSpec, ms_per_token: float, *,
@@ -1135,7 +1165,11 @@ def _router_procs_row(prefix: str) -> dict:
            "compute_dtype": "f32", "batch": 2,
            # the survivor absorbs the whole trace during the outage —
            # its admission queue must hold every not-yet-served request
-           "serve": {"stall_timeout": 60.0, "max_queue": n_req}}
+           "serve": {"stall_timeout": 60.0, "max_queue": n_req},
+           # worker-side flight recorder: each worker's step timeline
+           # rides its stats reply (span events are off the hot path —
+           # decode_every huge keeps the ring step-dominated)
+           "trace": {"capacity": 2048, "decode_every": 1 << 30}}
     # workers are single-process CPU JAX regardless of the bench backend
     # (the process tier is host-side plumbing; the chip stays with the
     # parent's measured rows); they share one persistent XLA compilation
@@ -1247,6 +1281,14 @@ def _router_procs_row(prefix: str) -> dict:
         sampling.clear()
         proc_stats = h0.proc_stats.summary()
         stats = router.stats
+        # worker-local step timelines (steps never cross the boundary;
+        # the stats reply carries each worker's summary) — keyed per
+        # replica so two workers' compositions never merge
+        step_timeline = {}
+        for h in handles:
+            s = (h.client.stats_summary() or {}) if h is not None else {}
+            for k, v in (s.get("step_timeline") or {}).items():
+                step_timeline[f"r{h.id}_{k}"] = v
         router.close()
         gc.collect()
 
@@ -1276,6 +1318,7 @@ def _router_procs_row(prefix: str) -> dict:
         "retries": stats.retries,
         "failovers_ok": stats.failovers_ok,
         "token_parity": parity,
+        "step_timeline": step_timeline,
         # the acceptance bars ride the row
         "within_bound": (kill_to_routable_ms is not None
                          and kill_to_routable_ms / 1e3 < spawn_timeout),
@@ -1352,10 +1395,13 @@ def _cluster_chaos_row(prefix: str) -> dict:
         died = next(e for e in w_ev if e["event"] == "dying")
         eof_ms.append((lost["t_wall"] - died["t_wall"]) * 1e3)
     # one stall run: detection latency ~= worker_timeout by construction,
-    # measured from the worker's LAST frame (the root's own accounting)
-    t0 = _time.time()
+    # measured from the worker's LAST frame (the root's own accounting).
+    # Monotonic clock for the local interval — an NTP step mid-run would
+    # corrupt a wall-clock difference (the cross-process t_wall deltas
+    # above are the one place wall clock is unavoidable)
+    t0 = _time.perf_counter()
     lost, _ = run_pair([], faults="recv_stall:after=2;times=0")
-    stall_wall_s = _time.time() - t0
+    stall_wall_s = _time.perf_counter() - t0
     eof_ms.sort()
     return {
         "metric": f"{prefix}_cluster_detect_eof_ms",
@@ -1387,26 +1433,28 @@ def _variant_rows(engine, params, spec: ModelSpec, repeats: int, emit) -> None:
     tok_s = _measure_prefill(engine, n_pre, max(repeats, 4))
     emit({
         "metric": "llama2_7b_q40_prefill_2048_tok_per_s",
-        "value": round(tok_s, 1), "unit": "tok/s", "vs_baseline": None})
+        "value": round(tok_s, 1), "unit": "tok/s", "vs_baseline": None,
+        "step_timeline": {}})
 
     spec8k = dataclasses.replace(spec, seq_len=8192)
     for cdt, name in ((jnp.bfloat16, "bf16"), (jnp.float8_e4m3fn, "f8")):
         eng = Engine(spec8k, params, compute_dtype=jnp.bfloat16,
                      cache_dtype=cdt, max_seq_len=8192)
-        ms8 = _measure_decode(eng, 256, 7680, repeats)
-        emit(_decode_row(
-            f"llama2_7b_q40_decode_8kfill_{name}_cache_ms_per_token",
-            spec8k, ms8, fill=7680, n_tokens=256,
-            cache_itemsize=jnp.dtype(cdt).itemsize))
+        emit(_with_step_timeline(
+            lambda eng=eng, cdt=cdt, name=name: _decode_row(
+                f"llama2_7b_q40_decode_8kfill_{name}_cache_ms_per_token",
+                spec8k, _measure_decode(eng, 256, 7680, repeats),
+                fill=7680, n_tokens=256,
+                cache_itemsize=jnp.dtype(cdt).itemsize)))
         del eng
         gc.collect()
 
-    emit(_shardmap_row(engine, params, spec, repeats))
-    emit(_lookup_row(engine, repeats))
+    emit(_with_step_timeline(_shardmap_row, engine, params, spec, repeats))
+    emit(_with_step_timeline(_lookup_row, engine, repeats))
     # batched decode needs its own engine (batch is a build-time shape);
     # the 7b weights are shared, the extra KV cache is 512-seq x 8 rows
-    emit(_batch_row(params, spec, repeats))
-    emit(_batch_lookup_row(params, spec, repeats))
+    emit(_with_step_timeline(_batch_row, params, spec, repeats))
+    emit(_with_step_timeline(_batch_lookup_row, params, spec, repeats))
 
 
 def _shardmap_row(engine, params, spec: ModelSpec, repeats: int) -> dict:
@@ -1558,11 +1606,14 @@ def main() -> None:
             max_seq_len=seq)
 
         repeats = int(os.environ.get("BENCH_REPEATS", "2"))
-        ms_per_token = _measure_decode(engine, n_tokens, fill, repeats)
-        out.update(_decode_row(metric, spec, ms_per_token, fill=fill,
-                               n_tokens=n_tokens,
-                               cache_itemsize=jnp.dtype(cache_dtype).itemsize,
-                               base=base))
+        main_row = _with_step_timeline(
+            lambda: _decode_row(
+                metric, spec, _measure_decode(engine, n_tokens, fill,
+                                              repeats),
+                fill=fill, n_tokens=n_tokens,
+                cache_itemsize=jnp.dtype(cache_dtype).itemsize, base=base))
+        ms_per_token = main_row["value"]
+        out.update(main_row)
         if model in ("moe", "grok", "70bt"):
             # truncated-depth configs: the per-layer cost is the number
             # that extrapolates to full depth (includes the shared
@@ -1579,15 +1630,15 @@ def main() -> None:
             # continuous-batching serving row (runtime/scheduler.py) —
             # behind a flag so the default bench ladder stays fast; the
             # driver opts in with BENCH_SERVE=1 for the serving A/B
-            emit(_serve_row(params, spec,
-                            prefix=metric.split("_decode")[0]))
+            emit(_with_step_timeline(_serve_row, params, spec,
+                                     prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_PREFIX", "0") != "0":
             # radix prefix-cache row (runtime/prefix_cache.py): the
             # shared-system-prompt trace served cache OFF vs ON —
             # prefill tokens saved %, TTFT delta, greedy token parity
-            emit(_prefix_row(params, spec,
-                             prefix=metric.split("_decode")[0]))
+            emit(_with_step_timeline(_prefix_row, params, spec,
+                                     prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_ROUTER", "0") != "0":
             # multi-replica router row (runtime/router.py): the shared-
@@ -1599,8 +1650,9 @@ def main() -> None:
             # process row only (the smoke tests pick one each)
             procs_knob = os.environ.get("BENCH_ROUTER_PROCS", "1")
             if procs_knob != "only":
-                emit(_router_row(params, spec,
-                                 prefix=metric.split("_decode")[0]))
+                emit(_with_step_timeline(
+                    _router_row, params, spec,
+                    prefix=metric.split("_decode")[0]))
             if procs_knob != "0":
                 # process-mode row (runtime/replica_worker.py): two real
                 # worker OS processes, one SIGKILLed mid-trace —
@@ -1612,12 +1664,14 @@ def main() -> None:
             # resilience row (runtime/resilience.py): the Poisson trace
             # replayed with injected mid-trace crashes — availability %,
             # recovered-request counts, recovery p50
-            emit(_chaos_row(params, spec,
-                            prefix=metric.split("_decode")[0]))
+            emit(_with_step_timeline(_chaos_row, params, spec,
+                                     prefix=metric.split("_decode")[0]))
             # cluster row (parallel/multihost.py): two-process control-
             # plane chaos — worker death/stall -> structured detection
-            # latency, bounded by --worker-timeout
-            emit(_cluster_chaos_row(prefix=metric.split("_decode")[0]))
+            # latency, bounded by --worker-timeout (no scheduler runs, so
+            # its step_timeline block is empty by construction)
+            emit(_with_step_timeline(
+                _cluster_chaos_row, prefix=metric.split("_decode")[0]))
 
         # extra capability rows, measured in the same run (driver default
         # config only — explicit BENCH_* overrides mean a targeted A/B)
@@ -1629,8 +1683,8 @@ def main() -> None:
             _variant_rows(engine, params, spec, repeats, emit)
             del engine, params  # free the 7b weights before the MoE rows
             gc.collect()
-            emit(_moe_row(repeats))
-            emit(_grok_row(repeats))
+            emit(_with_step_timeline(_moe_row, repeats))
+            emit(_with_step_timeline(_grok_row, repeats))
     except Exception as e:  # partial rows survive outages and Ctrl-C;
         # SIGTERM (a driver `timeout`) exits 0 via _flush_and_exit with an
         # "error" annotation — consumers must check the error FIELD, not

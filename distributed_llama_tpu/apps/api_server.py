@@ -825,9 +825,103 @@ def make_handler(state: ApiState):
                 cluster = cluster_summary()
                 if cluster is not None:
                     payload["cluster"] = cluster
+                from ..runtime.trace import TRACER
+                if TRACER.enabled:
+                    payload["trace"] = TRACER.summary()
                 self._json(200, payload)
+            elif self.path == "/metrics":
+                self._metrics()
+            elif (self.path == "/admin/trace"
+                  or self.path.startswith("/admin/trace?")):
+                self._admin_trace()
             else:
                 self._json(404, {"error": "not found"})
+
+        def _metrics(self) -> None:
+            """GET /metrics — Prometheus text exposition, identical names
+            in every serving tier (legacy single-engine, --serve-batch
+            supervisor, --replicas thread router, --replica-procs/-hosts
+            process router): the renderer consumes the SAME summary dict
+            /stats already serves, so a tier cannot drift its own metric
+            namespace. Answers in every tier (legacy/idle emit process-
+            level series only) — a scrape target must never 404 off a
+            launch flag."""
+            from ..parallel.multihost import cluster_summary
+            from ..runtime.trace import TRACER, render_prometheus
+
+            # mode comes from the CONFIG, not the lazily-built front
+            # door: a router tier must label its series mode="router"
+            # from the first scrape (a label flip after the first
+            # request would split every dllama_up series in two)
+            if state.serve_batch <= 0:
+                payload, mode, st = None, "legacy", "off"
+            else:
+                mode = "router" if state.router_mode else "scheduler"
+                if state._scheduler is None:
+                    # a scrape must never be the thing that allocates
+                    # the batched cache (same rule as /stats, /readyz)
+                    payload, st = None, "idle"
+                else:
+                    payload, st = state._scheduler.summary(), None
+            cluster = cluster_summary()
+            if cluster is not None:
+                payload = dict(payload or {})
+                payload["cluster"] = cluster
+            data = render_prometheus(payload, tracer=TRACER,
+                                     model=state.model_name, mode=mode,
+                                     state=st).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _admin_trace(self) -> None:
+            """GET /admin/trace[?n=200|?id=TID] — the flight-recorder
+            ring as JSONL (docs/observability.md schema): first line the
+            clock anchor, then one event per line, wall timestamps
+            attached at export. Operator surface, so the same guard as
+            the POST /admin/* verbs (loopback or --admin-token)."""
+            if not _admin_authorized(state, self.client_address[0],
+                                     self.headers.get("Authorization")):
+                self._json(403, {"error": "admin endpoints need loopback "
+                                          "or a valid --admin-token "
+                                          "bearer"})
+                return
+            from urllib.parse import parse_qs, urlparse
+
+            from ..runtime.trace import TRACER
+
+            if not TRACER.enabled:
+                self._json(404, {"error": "tracing off (start with "
+                                          "--trace)"})
+                return
+            try:
+                q = parse_qs(urlparse(self.path).query)
+                tid = int(q["id"][0]) if "id" in q else None
+                n = int(q.get("n", ["200"])[0])
+                if n < 0 or (tid is not None and tid < 0):
+                    # a negative n would slice the WRONG end of the ring
+                    # (evs[-n:] == evs[n:]) — reject, don't dump
+                    raise ValueError(n)
+            except (ValueError, IndexError):
+                self._json(400, {"error": "bad request"})
+                return
+            events = TRACER.by_id(tid) if tid is not None \
+                else TRACER.recent(n)
+            lines = [json.dumps({"anchor_wall": TRACER.anchor_wall,
+                                 "anchor_mono": TRACER.anchor_mono,
+                                 "events": len(events)})]
+            lines += [json.dumps({**e,
+                                  "ts_wall": TRACER.to_wall(e["ts"])})
+                      for e in events]
+            data = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
 
         def _readyz(self) -> None:
             """Readiness = engine healthy AND queue under bound (and not
@@ -1284,6 +1378,26 @@ def serve(args) -> None:
             or getattr(args, "route_policy", None) is not None):
         sys.exit("error: --retry-budget/--route-policy have no effect "
                  "without --replicas N > 1 or a process tier")
+    trace_on = bool(getattr(args, "trace", False))
+    if not trace_on and (
+            getattr(args, "trace_dir", None)
+            or getattr(args, "trace_sample", None) is not None
+            or getattr(args, "trace_buffer", None) is not None
+            or getattr(args, "trace_decode_every", None) is not None):
+        # dead-flag discipline, same as the prefix/router knobs: sizing
+        # a recorder that is off is silently-dead configuration
+        sys.exit("error: --trace-dir/--trace-sample/--trace-buffer/"
+                 "--trace-decode-every have no effect without --trace")
+    if trace_on:
+        sample = getattr(args, "trace_sample", None)
+        if sample is not None and not 0.0 <= sample <= 1.0:
+            sys.exit("error: --trace-sample must be in [0, 1]")
+        from ..runtime.trace import TRACER
+        TRACER.configure(
+            capacity=getattr(args, "trace_buffer", None) or 8192,
+            sample=1.0 if sample is None else float(sample),
+            decode_every=getattr(args, "trace_decode_every", None) or 8,
+            sink_dir=getattr(args, "trace_dir", None))
     replica_hosts = None
     if replica_hosts_raw:
         replica_hosts = []

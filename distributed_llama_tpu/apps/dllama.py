@@ -260,6 +260,34 @@ def build_argparser() -> argparse.ArgumentParser:
                         "an alternative to the loopback-only default "
                         "(constant-time compare) — required for "
                         "operating a remote-replica tier from off-box")
+    # flight recorder (runtime/trace.py, docs/observability.md): request
+    # spans + step timeline into a bounded ring, exported by
+    # GET /metrics (Prometheus) and GET /admin/trace (JSONL)
+    p.add_argument("--trace", action="store_true",
+                   help="api mode: enable the flight recorder — per-"
+                        "request lifecycle spans and the per-iteration "
+                        "step timeline, in a fixed-capacity ring served "
+                        "by /admin/trace and the dllama_step_ms /metrics "
+                        "family. Host-side; disabled it is a no-op "
+                        "(docs/observability.md quantifies the well-"
+                        "under-2%% enabled overhead)")
+    p.add_argument("--trace-buffer", type=int, default=None, metavar="N",
+                   help="ring capacity in events (default 8192; oldest "
+                        "events fall off first)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="also persist events as rotating JSONL files "
+                        "under DIR (16 MB x 8 files per process; replica "
+                        "workers write worker-rK/ subdirs)")
+    p.add_argument("--trace-sample", type=float, default=None, metavar="R",
+                   help="fraction of request SPANS persisted to "
+                        "--trace-dir (deterministic per trace id; the "
+                        "in-memory ring and /metrics always see "
+                        "everything). Default 1.0")
+    p.add_argument("--trace-decode-every", type=int, default=None,
+                   metavar="N",
+                   help="decode progress event cadence in tokens "
+                        "(default 8) — bounds how much ring one long "
+                        "stream can occupy")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,
@@ -419,7 +447,7 @@ def build_engine(args):
 
     # streamed sharded load: one tensor resident at a time, each shard
     # placed straight onto its device (ref weight push: transformer.cpp:562-621)
-    t0 = time.time()
+    t0 = time.perf_counter()
     tensor_src = None
     if getattr(args, "push_weights", False) and multihost:
         # rank 0 streams its file into the broadcast; workers consume the
@@ -430,7 +458,7 @@ def build_engine(args):
         spec, args.model, mesh, mode=mode, dtype=cdt, q80_collectives=q80,
         tensors=tensor_src)
     print(f"⏩ loaded {lstats.total_bytes / 1e9:.2f} GB in "
-          f"{time.time()-t0:.1f}s (peak host "
+          f"{time.perf_counter()-t0:.1f}s (peak host "
           f"{lstats.peak_host_bytes / 1e6:.0f} MB)")
     engine = Engine(
         spec, params, mesh,
@@ -587,7 +615,7 @@ def cmd_generate(args, benchmark: bool) -> None:
     if engine.batch > 1:
         # dp throughput mode: the batch rows generate independently (here the
         # same prompt replicated); row 0 streams to stdout
-        t0 = time.time()
+        t0 = time.perf_counter()
         if args.lookup_decode:
             # batched speculation (round 5): per-row drafts, one verify
             # forward per step, exact per-row greedy parity
@@ -611,7 +639,7 @@ def cmd_generate(args, benchmark: bool) -> None:
             outs = engine.generate_batch([tokens] * engine.batch,
                                          _steps(args, engine), sampler,
                                          eos_id=tokenizer.stop_token_ids())
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         _stream_pieces(tokenizer, tokens[-1], outs[0])
         if benchmark:
             n = sum(len(o) for o in outs)
@@ -620,7 +648,7 @@ def cmd_generate(args, benchmark: bool) -> None:
         return
 
     if args.device_sampling:
-        t0 = time.time()
+        t0 = time.perf_counter()
         with _maybe_profile(args):
             out = engine.generate_device(
                 tokens, _steps(args, engine),
@@ -628,7 +656,7 @@ def cmd_generate(args, benchmark: bool) -> None:
                 seed=sampler.rng_state,
                 eos_id=tokenizer.stop_token_ids(),
                 vocab_size=tokenizer.vocab_size)
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         _stream_pieces(tokenizer, tokens[-1], out)
         if benchmark:
             # honest accounting: this first call's wall time includes the
@@ -648,7 +676,7 @@ def cmd_generate(args, benchmark: bool) -> None:
     if args.lookup_decode:
         _announce_run(tokens, _steps(args, engine), sampler=sampler,
                       lookup=args.lookup_decode)
-        t0 = time.time()
+        t0 = time.perf_counter()
         with _maybe_profile(args):
             if args.temperature > 0:
                 # sampled speculation: distribution-exact via rejection
@@ -673,7 +701,7 @@ def cmd_generate(args, benchmark: bool) -> None:
                     eos_id=tokenizer.stop_token_ids(),
                     draft_len=args.lookup_decode, on_token=on_token,
                     vocab_size=tokenizer.vocab_size)
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         print()
         if benchmark:
             fwd, n = engine.last_accept_stats

@@ -3,9 +3,11 @@ from .faults import FAULTS, FaultError
 from .resilience import EngineSupervisor, EngineUnready
 from .scheduler import (PromptTooLong, QueueFull, RequestError, Scheduler,
                         SchedulerClosed, ServeRequest)
-from .stats import ServeStats, StepStats, SupervisorStats
+from .stats import ServeStats, StepStats, StepTimelineStats, SupervisorStats
+from .trace import TRACER, Tracer
 
 __all__ = ["Engine", "GenerationResult", "PromptTooLong", "Scheduler",
            "ServeRequest", "ServeStats", "StepStats", "FAULTS",
            "FaultError", "EngineSupervisor", "EngineUnready", "QueueFull",
-           "RequestError", "SchedulerClosed", "SupervisorStats"]
+           "RequestError", "SchedulerClosed", "SupervisorStats",
+           "StepTimelineStats", "TRACER", "Tracer"]

@@ -80,6 +80,8 @@ import dataclasses
 import os
 import threading
 
+from .trace import TRACER
+
 SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
          "replica_raise", "replica_stall", "worker_exit",
          "conn_refused", "recv_stall", "frame_truncate", "peer_close")
@@ -169,6 +171,12 @@ class FaultRegistry:
             if not a.should_fire():
                 return
             ms = a.ms
+            fired = a.fired
+        if TRACER.enabled:
+            # the flight recorder sees every fault that actually FIRED —
+            # a chaos timeline must show the injected kill next to the
+            # spans it killed (runtime/trace.py)
+            TRACER.event("fault", 0, site=site, key=key, n=fired)
         if site == "conn_refused":
             # the REAL exception type the connect retry path handles — an
             # injected refusal must walk the same backoff code as a root
@@ -199,7 +207,11 @@ class FaultRegistry:
             a = self._armed.get(site)
             if a is None or (a.key is not None and key != a.key):
                 return False
-            return a.should_fire()
+            fire = a.should_fire()
+            fired = a.fired
+        if fire and TRACER.enabled:
+            TRACER.event("fault", 0, site=site, key=key, n=fired)
+        return fire
 
     def load_env(self, env=None) -> None:
         """Parse ``DLLAMA_FAULTS`` (see module docstring). Malformed specs

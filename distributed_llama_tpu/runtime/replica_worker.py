@@ -68,8 +68,14 @@ from .resilience import EngineUnready
 from .scheduler import (PromptTooLong, QueueFull, RequestError,
                         SchedulerClosed)
 from .stats import RequestStats, ServeStats
+from .trace import TRACER
 
-REPLICA_PROTOCOL_VERSION = 1
+# v2: the submit header grew a trace id (flight-recorder span linkage
+# across the process boundary, runtime/trace.py) and workers ship their
+# span events back in RMSG_TRACE frames — the version handshake turns a
+# mixed-version parent/worker pair into a clean HELLO failure instead of
+# a misparsed frame
+REPLICA_PROTOCOL_VERSION = 2
 
 # message kinds — a namespace distinct from the cluster control plane's
 # MSG_* so a replica socket accidentally pointed at a cluster control
@@ -92,10 +98,14 @@ RMSG_RESET = 114        # client -> worker: reset the ENGINE breaker
 RMSG_REBUILD = 115      # client -> worker: rebuild the supervisor in place
 RMSG_SHUTDOWN = 116     # client -> worker: graceful exit 0
 RMSG_OK = 117           # worker -> client: JSON ack for admin verbs
+RMSG_TRACE = 118        # worker -> client: JSON span events for this
+#                         request's trace id, sent just before the
+#                         terminal frame (the parent tracer merges them
+#                         onto its own timeline — runtime/trace.py)
 
 # [max_tokens, temp_bits, topp_bits, rng_lo, rng_hi, vocab, deadline_ms,
-#  n_eos] then n_eos stop ids then the prompt
-_SUBMIT_HEADER = 8
+#  n_eos, trace_id] then n_eos stop ids then the prompt
+_SUBMIT_HEADER = 9
 
 EXIT_WORKER_FAULT = 86   # the worker_exit fault site's os._exit code
 
@@ -241,7 +251,7 @@ class ReplicaServer:
         if len(ints) < _SUBMIT_HEADER:
             raise ClusterProtocolError(f"short submit header: {len(ints)}")
         (max_tokens, temp_b, topp_b, rng_lo, rng_hi, vocab,
-         deadline_ms, n_eos) = ints[:_SUBMIT_HEADER]
+         deadline_ms, n_eos, trace_id) = ints[:_SUBMIT_HEADER]
         eos = [int(t) for t in ints[_SUBMIT_HEADER:_SUBMIT_HEADER + n_eos]]
         prompt = [int(t) for t in ints[_SUBMIT_HEADER + n_eos:]]
         sampler = Sampler(int(vocab), temperature=_bits_f32(temp_b),
@@ -255,8 +265,13 @@ class ReplicaServer:
         with self._sup_lock:
             sup = self.sup
         try:
+            # the PARENT minted the trace id: worker-side scheduler events
+            # carry it so the shipped span merges onto the parent's
+            # timeline (trace_id=0 -> None lets an untraced parent leave
+            # the worker's own minting behavior unchanged)
             req = sup.submit(prompt, int(max_tokens), sampler,
-                             eos_id=set(eos) or None, deadline=deadline)
+                             eos_id=set(eos) or None, deadline=deadline,
+                             trace_id=int(trace_id) or None)
         except QueueFull as e:
             self._refuse(conn, {"code": "queue_full", "message": str(e),
                                 "retry_after": e.retry_after})
@@ -332,6 +347,7 @@ class ReplicaServer:
                     os._exit(EXIT_WORKER_FAULT)
                 _send_frame(wsock, RMSG_TOKEN, [val], timeout=self._io)
             elif kind == "done":
+                self._ship_trace(wsock, req)
                 _send_frame(wsock, RMSG_DONE, [], json.dumps(
                     {"finish_reason": req.finish_reason or val}).encode(),
                     timeout=self._io)
@@ -340,9 +356,26 @@ class ReplicaServer:
                 frame = (dict(val) if isinstance(val, dict)
                          else {"code": "error", "message": str(val),
                                "retryable": True})
+                self._ship_trace(wsock, req)
                 _send_frame(wsock, RMSG_ERROR, [],
                             json.dumps(frame).encode(), timeout=self._io)
                 return
+
+    def _ship_trace(self, wsock: socket.socket, req) -> None:
+        """Ship this request's worker-side span ahead of the terminal
+        frame (RMSG_TRACE): events carry wall-clock timestamps so the
+        parent tracer rebases them onto ITS monotonic timeline — a
+        surviving request's cross-process story merges; a SIGKILLed
+        worker simply never ships (the parent's own casualty events and
+        the monitor's classified worker_exit tell that half)."""
+        tid = getattr(req, "trace_id", 0)
+        if not tid or not TRACER.enabled:
+            return
+        events = TRACER.export_span(tid)
+        if events:
+            _send_frame(wsock, RMSG_TRACE, [tid],
+                        json.dumps({"events": events}).encode(),
+                        timeout=self._io)
 
     def _refuse(self, conn: socket.socket, payload: dict) -> None:
         _send_frame(conn, RMSG_REFUSE, [], json.dumps(payload).encode(),
@@ -407,6 +440,13 @@ class ReplicaServer:
         for k in _COUNTER_KEYS:
             out[k] = out.get(k, 0) + carry[k]
         out["pid"] = os.getpid()
+        if TRACER.enabled:
+            # the step timeline is WORKER-local (the parent never sees
+            # our iterations) — ride it on the stats reply so the bench
+            # procs row and a curious operator get it across the
+            # boundary without a new verb
+            out["step_timeline"] = TRACER.steps.summary_json()
+            out["trace"] = TRACER.summary()
         return out
 
     def _rebuild(self) -> None:
@@ -533,6 +573,15 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
             "request_deadline": getattr(args, "request_deadline", 0.0),
             "stall_timeout": getattr(args, "stall_timeout", 0.0),
         },
+        # flight recorder: workers trace whenever the parent does, so
+        # span events exist on both sides of the process boundary
+        **({"trace": {
+            "capacity": getattr(args, "trace_buffer", None) or 8192,
+            "sample": (1.0 if getattr(args, "trace_sample", None) is None
+                       else args.trace_sample),
+            "decode_every": getattr(args, "trace_decode_every", None) or 8,
+            "dir": getattr(args, "trace_dir", None),
+        }} if getattr(args, "trace", False) else {}),
     }
 
 
@@ -558,6 +607,21 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as e:
         _emit("config_error", error=f"{type(e).__name__}: {e}")
         return 2
+
+    tr = cfg.get("trace")
+    if tr:
+        # per-worker flight recorder (runtime/trace.py): spans ship back
+        # to the parent in RMSG_TRACE frames; a sink directory gets a
+        # per-worker subdir so two processes never fight over one file
+        # rotation sequence
+        sink = tr.get("dir")
+        if sink:
+            sink = os.path.join(
+                sink, f"worker-{cfg.get('fault_key') or os.getpid()}")
+        TRACER.configure(capacity=int(tr.get("capacity", 8192)),
+                         sample=float(tr.get("sample", 1.0)),
+                         decode_every=int(tr.get("decode_every", 8)),
+                         sink_dir=sink)
 
     sup_factory = build_supervisor_factory(cfg)
     server = ReplicaServer(sup_factory, host=args.host, port=args.port,
@@ -625,8 +689,11 @@ class _RemoteStream:
     existing failover machinery takes it from there."""
 
     def __init__(self, sock: socket.socket, io_timeout: float,
-                 n_prompt: int, rid: int):
+                 n_prompt: int, rid: int, trace_id: int = 0,
+                 origin: str = "worker"):
         self.id = rid
+        self.trace_id = trace_id
+        self._origin = origin
         self._sock = sock
         self._wsock = sock.dup()   # cancel() sends here; reads stay on
         # _sock so the two directions' deadlines never share settimeout
@@ -655,6 +722,7 @@ class _RemoteStream:
                     frame = _recv_frame(self._sock,
                                         timeout=min(self._io, timeout))
                 except (OSError, ClusterProtocolError) as e:
+                    self._trace_lost(f"{type(e).__name__}")
                     raise RequestError(
                         "replica_lost",
                         f"replica connection lost mid-request: "
@@ -662,6 +730,7 @@ class _RemoteStream:
                 if frame is None:
                     # mid-stream EOF: the worker process died (SIGKILL,
                     # OOM, segfault) — the kernel closed its sockets
+                    self._trace_lost("eof")
                     raise RequestError(
                         "replica_lost",
                         "replica closed the connection before the "
@@ -671,9 +740,32 @@ class _RemoteStream:
                     now = time.perf_counter()
                     if self.stats.t_first is None:
                         self.stats.t_first = now
+                        if TRACER.enabled and self.trace_id:
+                            # the CLIENT-side TTFT edge: a SIGKILLed
+                            # worker can never ship its span, so the
+                            # casualty's "it was streaming" fact must be
+                            # recorded on this side of the boundary.
+                            # side="client" tells it apart from the
+                            # worker's OWN first_token (which arrives
+                            # later via RMSG_TRACE with the same origin
+                            # but a smaller, worker-internal ttft_ms)
+                            TRACER.event("first_token", self.trace_id,
+                                         side="client",
+                                         origin=self._origin,
+                                         ttft_ms=round(
+                                             (now - self.stats.t_submit)
+                                             * 1e3, 3))
                     self.stats.n_out += 1
                     yield int(frame[1][0])
                 elif kind == RMSG_KEEPALIVE:
+                    continue
+                elif kind == RMSG_TRACE:
+                    # the worker's span events, wall-stamped; merge them
+                    # onto the parent timeline (no-op when untraced)
+                    if TRACER.enabled:
+                        payload = json.loads(frame[2] or b"{}")
+                        TRACER.ingest(payload.get("events", []),
+                                      origin=self._origin)
                     continue
                 elif kind == RMSG_DONE:
                     payload = json.loads(frame[2] or b"{}")
@@ -687,6 +779,7 @@ class _RemoteStream:
                                        fr.get("message", "replica error"),
                                        fr.get("retryable", True))
                 else:
+                    self._trace_lost(f"frame_kind_{kind}")
                     raise RequestError(
                         "replica_lost",
                         f"unexpected frame kind {kind} in a token stream",
@@ -694,6 +787,15 @@ class _RemoteStream:
         finally:
             self.finished.set()
             self._close()
+
+    def _trace_lost(self, how: str) -> None:
+        """Parent-side casualty record: the worker died (or tore the
+        connection) mid-request, so ITS tracer can never ship this span
+        — the error event the timeline needs lives on this side."""
+        if TRACER.enabled and self.trace_id:
+            TRACER.event("error", self.trace_id, code="replica_lost",
+                         retryable=True, n_out=self.stats.n_out,
+                         how=how, side="client", origin=self._origin)
 
     def _close(self) -> None:
         for s in (self._sock, self._wsock):
@@ -759,7 +861,7 @@ class WorkerClient:
             raise
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None) -> _RemoteStream:
+               deadline=None, trace_id: int = 0) -> _RemoteStream:
         """Place one request on the worker. Door refusals re-raise the
         SAME exception types the in-process supervisor uses (QueueFull /
         EngineUnready / PromptTooLong / SchedulerClosed), so the router's
@@ -776,7 +878,7 @@ class WorkerClient:
         ints = [int(max_tokens), _f32_bits(sampler.temperature),
                 _f32_bits(sampler.topp), rng & 0xFFFFFFFF,
                 (rng >> 32) & 0xFFFFFFFF, sampler.vocab_size,
-                deadline_ms, len(eos), *eos, *prompt]
+                deadline_ms, len(eos), int(trace_id), *eos, *prompt]
         try:
             sock = self._connect()
         except (OSError, ClusterProtocolError) as e:
@@ -809,7 +911,9 @@ class WorkerClient:
             sock.close()
             raise EngineUnready("bad accept frame", 1.0)
         rs = _RemoteStream(sock, self._io, len(prompt),
-                           int(frame[1][0]) if frame[1] else 0)
+                           int(frame[1][0]) if frame[1] else 0,
+                           trace_id=int(trace_id),
+                           origin=f"worker@{self.addr[0]}:{self.addr[1]}")
         self.stats.requests.append(rs.stats)
         return rs
 

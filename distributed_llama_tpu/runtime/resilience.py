@@ -47,6 +47,7 @@ import time
 
 from .scheduler import Scheduler
 from .stats import SupervisorStats
+from .trace import TRACER
 
 READY = "ready"
 RECOVERING = "recovering"
@@ -168,14 +169,14 @@ class EngineSupervisor:
         return not self.max_queue or len(sched._queue) < self.max_queue
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None):
+               deadline=None, trace_id=None):
         with self._state_lock:
             if self._state != READY:
                 self.sup_stats.rejected_unready += 1
                 raise EngineUnready(self._state, self._retry_after())
             sched = self._sched
         req = sched.submit(prompt, max_tokens, sampler, eos_id=eos_id,
-                           deadline=deadline)
+                           deadline=deadline, trace_id=trace_id)
         if sched._stop and not req.finished.is_set():
             # the generation died between the state check and the enqueue:
             # its abort may already have drained the queue, so deliver this
@@ -269,6 +270,9 @@ class EngineSupervisor:
             self._state = BROKEN
             self.sup_stats.cluster_losses += 1
             self.sup_stats.consecutive_failures = self.breaker_threshold
+        if TRACER.enabled:
+            TRACER.event("cluster_lost", 0, msg=str(exc)[:200],
+                         key=self._fault_key)
         # retryable=False: the SAME replica cannot serve a retry until an
         # operator (or orchestrator) restores the lost worker and resets
         # the breaker — clients should fail over, not hammer
@@ -285,6 +289,9 @@ class EngineSupervisor:
             self._rebuild_thread = threading.Thread(
                 target=self._rebuild, args=(time.perf_counter(),),
                 daemon=True)
+        if TRACER.enabled:
+            TRACER.event("circuit", 0, scope="engine", state="half_open",
+                         key=self._fault_key)
         self._rebuild_thread.start()
 
     def summary(self) -> dict:
@@ -388,6 +395,9 @@ class EngineSupervisor:
             if kind == "crash":
                 self.sup_stats.crashes += 1
             self.sup_stats.consecutive_failures += 1
+        if TRACER.enabled:
+            TRACER.event("engine_failure", 0, failure=kind, msg=msg[:200],
+                         gen=gen, key=self._fault_key)
         # abort OUTSIDE the state lock (waiter wakeups run arbitrary
         # consumer code) and WITHOUT the step mutex (a wedged step holds
         # it forever) — see Scheduler._abort_all
@@ -407,6 +417,10 @@ class EngineSupervisor:
                 n = self.sup_stats.consecutive_failures
                 if n >= self.breaker_threshold:
                     self._state = BROKEN  # circuit open: stay unready
+                    if TRACER.enabled:
+                        TRACER.event("circuit", 0, scope="engine",
+                                     state="open", fails=n,
+                                     key=self._fault_key)
                     return
             time.sleep(min(self._backoff_base * (2 ** max(n - 1, 0)),
                            self._backoff_max))
@@ -437,7 +451,10 @@ class EngineSupervisor:
                 self._sched = sched
                 self._state = READY
                 self.sup_stats.recoveries += 1
-                self.sup_stats.recovery_ms.append(
-                    (time.perf_counter() - t_detect) * 1e3)
+                recovery_ms = (time.perf_counter() - t_detect) * 1e3
+                self.sup_stats.recovery_ms.append(recovery_ms)
+            if TRACER.enabled:
+                TRACER.event("recovery", 0, ms=round(recovery_ms, 3),
+                             gen=gen, key=self._fault_key)
             self._start_loop(sched, gen)
             return

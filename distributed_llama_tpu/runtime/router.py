@@ -62,6 +62,7 @@ from collections import OrderedDict
 from .resilience import _COUNTER_KEYS, EngineSupervisor, EngineUnready
 from .scheduler import QueueFull, RequestError, SchedulerClosed
 from .stats import RouterStats, percentile
+from .trace import TRACER
 
 POLICIES = ("cache_aware", "least_loaded", "round_robin")
 
@@ -383,7 +384,7 @@ class RemoteReplicaHandle:
         return _RemoteEngineInfo(self.client)
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None):
+               deadline=None, trace_id=None):
         if self._broken or self._closed:
             raise EngineUnready(self.state, self._retry_after())
         if not self._health.get("ready"):
@@ -392,7 +393,8 @@ class RemoteReplicaHandle:
             # worker is routable again within self._poll)
             raise EngineUnready(self.state, self._retry_after())
         return self.client.submit(prompt, max_tokens, sampler,
-                                  eos_id=eos_id, deadline=deadline)
+                                  eos_id=eos_id, deadline=deadline,
+                                  trace_id=trace_id or 0)
 
     def exclusive(self):
         raise EngineUnready("remote replica: no borrowable local engine",
@@ -564,6 +566,12 @@ class RemoteReplicaHandle:
 
         t_detect = time.perf_counter()
         cls = classify_exit(rc)
+        if TRACER.enabled:
+            # the classified exit ON the timeline: with the casualty
+            # span's replica_lost error and the sibling retry's route
+            # event this is the cross-process kill story in one place
+            TRACER.event("worker_exit", 0, replica=self.id, cls=cls,
+                         rc=rc)
         with self._lock:
             if self._closed:
                 return
@@ -590,6 +598,10 @@ class RemoteReplicaHandle:
             if self._spawn_fails >= self._spawn_breaker:
                 self._broken = True
                 self._health = {**self._health, "state": "broken"}
+                if TRACER.enabled:
+                    TRACER.event("circuit", 0, scope="spawn",
+                                 replica=self.id, state="open",
+                                 fails=self._spawn_fails)
         while not self._closed:
             while self._broken and not self._closed:
                 time.sleep(self._poll)  # breaker open: reset_breaker
@@ -619,6 +631,10 @@ class RemoteReplicaHandle:
                     if self._spawn_fails >= self._spawn_breaker:
                         self._broken = True
                         self._health = {**self._health, "state": "broken"}
+                        if TRACER.enabled:
+                            TRACER.event("circuit", 0, scope="spawn",
+                                         replica=self.id, state="open",
+                                         fails=self._spawn_fails)
                 continue
             with self._lock:
                 if self._closed:
@@ -627,8 +643,11 @@ class RemoteReplicaHandle:
                 self.client.set_addr(self._proc.host, port)
                 self._spawned_at = time.perf_counter()
                 self.proc_stats.respawns += 1
-                self.proc_stats.respawn_ms.append(
-                    (time.perf_counter() - t_detect) * 1e3)
+                respawn_ms = (time.perf_counter() - t_detect) * 1e3
+                self.proc_stats.respawn_ms.append(respawn_ms)
+            if TRACER.enabled:
+                TRACER.event("respawn", 0, replica=self.id,
+                             ms=round(respawn_ms, 1), port=port)
             self._refresh_health()
             return
 
@@ -645,7 +664,12 @@ class RouterRequest:
     ``cancel()``, ``finished``, ``finish_reason``, ``stats``."""
 
     def __init__(self, router: "Router", prompt: list[int], max_tokens: int,
-                 eos_id, deadline, sampler_spec: tuple, session):
+                 eos_id, deadline, sampler_spec: tuple, session,
+                 trace_id: int = 0):
+        # one span id for the WHOLE request: every failover attempt's
+        # scheduler/worker events carry it, so the casualty and its
+        # sibling retry share a timeline (runtime/trace.py)
+        self.trace_id = trace_id
         self._router = router
         self._prompt = prompt
         self._max_tokens = max_tokens
@@ -753,6 +777,10 @@ class RouterRequest:
                         or self.retries >= self._router.retry_budget):
                     self._terminal_error()
                     raise
+                if TRACER.enabled:
+                    TRACER.event("failover", self.trace_id,
+                                 replica=failed.id if failed else None,
+                                 code=e.code, attempt=self.retries + 1)
                 try:
                     self._router._place(
                         self, exclude=(failed.id,) if failed else (),
@@ -887,8 +915,9 @@ class Router:
             deadline = time.perf_counter() + self._request_deadline
         spec = (sampler.vocab_size, sampler.temperature, sampler.topp,
                 sampler.rng_state)
+        tid = TRACER.new_id() if TRACER.enabled else 0
         req = RouterRequest(self, [int(t) for t in prompt], max_tokens,
-                            eos_id, deadline, spec, session)
+                            eos_id, deadline, spec, session, trace_id=tid)
         self._place(req, exclude=(), sampler=sampler)
         return req
 
@@ -1099,7 +1128,8 @@ class Router:
             try:
                 inner = h.sup.submit(req._prompt, req._max_tokens, sampler,
                                      eos_id=req._eos_id,
-                                     deadline=req._deadline)
+                                     deadline=req._deadline,
+                                     trace_id=req.trace_id)
             except (EngineUnready, QueueFull, SchedulerClosed) as e:
                 if probe:
                     self._release_probe(h)
@@ -1118,6 +1148,10 @@ class Router:
             # process replica records the routed prompt in its shadow
             # index (cache-aware placement without an RPC)
             h.note_routed(req._prompt)
+            if TRACER.enabled:
+                TRACER.event("route", req.trace_id, replica=h.id,
+                             reason=reason, attempt=req.retries,
+                             probe=probe)
             with self._lock:
                 req._inner, req._handle = inner, h
                 req._probe = probe
@@ -1146,11 +1180,15 @@ class Router:
             return
         with self._lock:
             if ok:
+                was_open = h.open_until > 0.0
                 h.fails = 0
                 h.open_until = 0.0
                 h.probing = False
                 if retried:
                     self.stats.failovers_ok += 1
+                if was_open and TRACER.enabled:
+                    TRACER.event("circuit", 0, scope="router",
+                                 replica=h.id, state="closed")
                 return
             h.fails += 1
             now = time.perf_counter()
@@ -1159,6 +1197,10 @@ class Router:
             if h.fails >= self.circuit_threshold or reopening:
                 if h.open_until <= 0.0 or reopening:
                     self.stats.breaker_trips += 1
+                    if TRACER.enabled:
+                        TRACER.event("circuit", 0, scope="router",
+                                     replica=h.id, state="open",
+                                     fails=h.fails)
                 h.open_until = now + self.circuit_cooldown
 
 

@@ -66,6 +66,7 @@ import numpy as np
 
 from .faults import FAULTS
 from .stats import RequestStats, ServeStats
+from .trace import TRACER
 
 
 class PromptTooLong(ValueError):
@@ -116,8 +117,13 @@ class ServeRequest:
 
     def __init__(self, rid: int, prompt: list[int], max_tokens: int,
                  sampler, stop_ids: set[int],
-                 deadline: float | None = None):
+                 deadline: float | None = None, trace_id: int = 0):
         self.id = rid
+        # flight-recorder span id (runtime/trace.py): minted ONCE per
+        # client request at the front door and shared by every retry
+        # attempt (and, across the process boundary, by the worker's
+        # events) — 0 means untraced
+        self.trace_id = trace_id
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.sampler = sampler
@@ -245,7 +251,8 @@ class Scheduler:
 
     def submit(self, prompt: list[int], max_tokens: int, sampler,
                eos_id: int | set[int] | None = None,
-               deadline: float | None = None) -> ServeRequest:
+               deadline: float | None = None,
+               trace_id: int | None = None) -> ServeRequest:
         """Enqueue a request; it joins the running batch as soon as a slot
         frees. `sampler` is PER REQUEST (its RNG stream is the slot's
         sampling state — concurrent requests never share coins).
@@ -276,9 +283,18 @@ class Scheduler:
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
+        if trace_id is None:
+            # single-supervisor tier: the scheduler door IS the front
+            # door, so it mints the span id (the router mints earlier so
+            # retries share one id and passes it through here)
+            trace_id = TRACER.new_id() if TRACER.enabled else 0
         req = ServeRequest(rid, prompt, max_tokens, sampler, stop_ids,
-                           deadline=deadline)
+                           deadline=deadline, trace_id=trace_id)
         req.stats.t_submit = now
+        if TRACER.enabled:
+            TRACER.event("enqueue", trace_id, rid=rid,
+                         n_prompt=len(prompt), max_tokens=max_tokens,
+                         key=self.fault_key)
         with self._rid_lock:
             self.stats.requests_submitted += 1
         self.stats.requests.append(req.stats)  # deque.append: atomic
@@ -368,6 +384,18 @@ class Scheduler:
             # wait for the NEXT iteration: every live row gets at most one
             # decode forward per iteration (bounded ITL under admission)
             self._decode(dec)
+        if TRACER.enabled:
+            # step timeline: batch composition + wall ms, the raw
+            # measurement behind /metrics' dllama_step_ms and the bench
+            # step_timeline blocks (ROADMAP item 1's knee search). Wall
+            # from the watchdog heartbeat t0 — one clock, no extra read
+            # at step entry.
+            TRACER.step(decode_rows=len(dec), prefill_rows=len(pre),
+                        chunk=self.chunk if pre else 0,
+                        queue_depth=len(self._queue),
+                        wall_ms=(time.perf_counter()
+                                 - self._step_t0) * 1e3,
+                        key=self.fault_key)
         return True
 
     def _expire_req(self, req: ServeRequest, code: str = "deadline",
@@ -403,6 +431,11 @@ class Scheduler:
             s.n_out = 0
             s.last = 0
             s.pins = ()
+            if TRACER.enabled:
+                TRACER.event("admit", req.trace_id, slot=s.idx,
+                             queue_ms=round(
+                                 (now - req.stats.t_submit) * 1e3, 3),
+                             key=self.fault_key)
             # slot "reset" is host-side bookkeeping ONLY — no cache zeroing
             # or reallocation. The new request's prefill/decode overwrites
             # every position before any of its queries can attend it, so
@@ -417,6 +450,11 @@ class Scheduler:
                     self.prefix_cache.seed_slot(s.idx, ids)
                     s.off = n
                     s.pins = pins
+                if TRACER.enabled:
+                    # recorded even on a miss (hit=0): a cold prefill is
+                    # timeline information too
+                    TRACER.event("seed", req.trace_id, hit=n,
+                                 n_prompt=len(req.prompt))
                 # (tokens_prefilled is counted per dispatched chunk in
                 # _prefill_chunk — counting the whole suffix here would
                 # overstate the denominator for requests cancelled or
@@ -440,6 +478,9 @@ class Scheduler:
             # overwritten by decode before any later query attends them
             pos[s.idx] = s.off
             lidx[s.idx] = n - 1
+            if TRACER.enabled:
+                TRACER.event("prefill", s.req.trace_id, off=s.off, n=n,
+                             slot=s.idx)
             s.off += n
             if s.off == len(s.req.prompt):
                 finishing.append(s)
@@ -494,6 +535,14 @@ class Scheduler:
         now = time.perf_counter()
         if req.stats.t_first is None:
             req.stats.t_first = now
+            if TRACER.enabled:
+                TRACER.event("first_token", req.trace_id,
+                             ttft_ms=round((now - req.stats.t_submit)
+                                           * 1e3, 3))
+        elif TRACER.enabled and s.n_out % TRACER.decode_every == 0:
+            # decode progress at a bounded cadence: a per-token event
+            # would let one long stream flush the whole ring
+            TRACER.event("decode", req.trace_id, n_out=s.n_out)
         req.stats.n_out = s.n_out
         self.stats.tokens_out += 1
         req.events.put(("token", token))
@@ -538,6 +587,9 @@ class Scheduler:
         req.finish_reason = reason
         req.stats.t_done = time.perf_counter()
         self.stats.requests_finished += 1
+        if TRACER.enabled:
+            TRACER.event("finish", req.trace_id, reason=reason,
+                         n_out=req.stats.n_out)
         req.events.put(("done", reason))
         req.finished.set()
 
@@ -606,6 +658,11 @@ class Scheduler:
         req.stats.t_done = time.perf_counter()
         self.stats.requests_finished += 1
         self.stats.requests_failed += 1
+        if TRACER.enabled:
+            TRACER.event("error", req.trace_id,
+                         code=frame.get("code", "error"),
+                         retryable=bool(frame.get("retryable", True)),
+                         n_out=req.stats.n_out, key=self.fault_key)
         req.events.put(("error", dict(frame)))
         req.finished.set()
         return True

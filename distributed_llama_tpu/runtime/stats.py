@@ -46,7 +46,11 @@ class RunStats:
 def percentile(xs: list, p: float):
     """Nearest-rank percentile over a small sample (None when empty) —
     TTFT/ITL distributions are tens of requests, not enough to justify
-    interpolation."""
+    interpolation (deliberately NO linear interpolation: p50 of [1, 2]
+    is one of the observed values, never an invented 1.5). p is clamped
+    to [0, 100]: p0 is the min, p100 the max, a single element answers
+    every p. Backs every reported p50/p99 in this module —
+    tests/test_stats.py pins the edge cases."""
     if not xs:
         return None
     xs = sorted(xs)
@@ -120,6 +124,63 @@ class PrefixCacheStats:
             "publish_drops": self.publish_drops,
             "invalidations": self.invalidations,
         }
+
+
+class StepTimelineStats:
+    """Per-batch-composition step-duration histograms (owned by
+    runtime/trace.Tracer): every scheduler iteration records its wall ms
+    keyed by (decode_rows, prefill_rows, chunk) — the raw measurement the
+    batch-knee search (ROADMAP item 1) needs, the ``dllama_step_ms``
+    /metrics family, and the bench rows' ``step_timeline`` block.
+    Bounded: ``window`` samples per composition, at most ``max_keys``
+    distinct compositions (the composition space is small by
+    construction — decode_rows and prefill_rows are <= batch, chunk is
+    one fixed width — but a bound beats trusting that)."""
+
+    def __init__(self, window: int = 4096, max_keys: int = 256):
+        import threading
+        from collections import deque
+
+        self.window = int(window)
+        self.max_keys = int(max_keys)
+        self._lock = threading.Lock()
+        self._hist: dict[tuple, object] = {}
+        self.overflow = 0  # samples dropped past max_keys
+
+    def record(self, decode_rows: int, prefill_rows: int, chunk: int,
+               wall_ms: float) -> None:
+        from collections import deque
+
+        key = (int(decode_rows), int(prefill_rows), int(chunk))
+        with self._lock:
+            d = self._hist.get(key)
+            if d is None:
+                if len(self._hist) >= self.max_keys:
+                    self.overflow += 1
+                    return
+                d = self._hist[key] = deque(maxlen=self.window)
+            d.append(wall_ms)
+
+    def summary(self) -> dict:
+        """{(dec, pre, chunk): {n, p50_ms, p99_ms, mean_ms}} over the
+        sliding windows, busiest composition first."""
+        with self._lock:
+            items = [(k, list(d)) for k, d in self._hist.items()]
+        out = {}
+        for key, xs in sorted(items, key=lambda kv: -len(kv[1])):
+            out[key] = {
+                "n": len(xs),
+                "p50_ms": round(percentile(xs, 50), 4),
+                "p99_ms": round(percentile(xs, 99), 4),
+                "mean_ms": round(sum(xs) / len(xs), 4),
+            }
+        return out
+
+    def summary_json(self) -> dict:
+        """summary() with string keys ("dec4_pre1_c16") — the BENCH json
+        block (tuple keys do not survive json.dumps)."""
+        return {f"dec{k[0]}_pre{k[1]}_c{k[2]}": v
+                for k, v in self.summary().items()}
 
 
 @dataclasses.dataclass

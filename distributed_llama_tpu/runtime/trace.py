@@ -1,0 +1,615 @@
+"""Flight recorder: request tracing, step timeline, and the /metrics plane.
+
+The serving stack is five layers deep (router → worker process →
+supervisor → scheduler → engine) but until this module its only window
+was aggregate ``/stats`` snapshots: when the chaos bench SIGKILLs a
+worker mid-stream nothing could reconstruct WHICH request died WHERE,
+and the batch-knee search (ROADMAP item 1) had no per-iteration data to
+mine. Orca frames scheduling as an iteration-level tradeoff — chunked-
+prefill width vs decode occupancy — which is only tunable if every
+iteration is observable; vLLM's production deployments made block-pool
+and batch-composition metrics the standard operational surface for
+exactly this stack shape (PAPERS.md). This module is that surface:
+
+  * **Per-request spans** — ``Tracer`` records each request's lifecycle
+    (``enqueue → admit → seed → prefill → first_token → decode/N →
+    finish|error``) plus the failure-machinery events that explain a
+    timeline (``failover``, ``circuit``, ``fault``, ``worker_exit``,
+    ``respawn``, ``engine_failure``, ``recovery``) into a fixed-capacity
+    ring buffer. Appends are lock-cheap (``deque(maxlen=N).append`` is
+    GIL-atomic; the only lock guards the step histograms and the sink),
+    and the DISABLED path is an allocation-free no-op: hot call sites
+    guard on ``TRACER.enabled`` before building any kwargs, so a server
+    launched without ``--trace`` pays one attribute read per site.
+  * **Step timeline** — every scheduler iteration records its batch
+    composition (decode rows, prefill rows × chunk width, queue depth)
+    and wall ms, histogrammed per composition
+    (:class:`stats.StepTimelineStats`): the raw measurement the batch-
+    knee search needs, and the ``dllama_step_ms`` family of /metrics.
+  * **Export plane** — :func:`render_prometheus` turns the existing
+    /stats summary dicts (supervisor- or router-shaped) plus the
+    tracer's histograms into Prometheus text exposition format
+    (``GET /metrics`` in apps/api_server.py, every serving tier);
+    ``GET /admin/trace`` serves the ring as JSONL; ``--trace-dir``
+    attaches a rotating JSONL sink with a per-request sample rate.
+
+Trace ids are minted ONCE per client request (at the router or, single-
+supervisor, at the scheduler door) and ride every event — including
+across the process boundary: the submit frame carries the id to replica
+workers (runtime/replica_worker.py, protocol v2) and workers ship their
+span back in ``RMSG_TRACE`` frames, so a SIGKILL'd worker's partial
+stream and its bit-identical sibling retry appear on ONE timeline.
+
+Clock domain: every timestamp is ``time.perf_counter()`` — the same
+monotonic clock the scheduler's deadlines, TTFT/ITL stats, and the
+supervisor's watchdog already use (never ``time.time()``, which steps
+under NTP and can yield negative intervals). One (wall, mono) anchor
+pair per tracer converts to wall clock at EXPORT time only, which is
+also how worker-process events rebase onto the parent's timeline.
+
+Everything here is host code: no jitted entry point is touched, events
+fire strictly pre/post device dispatch, and the dlgrind fingerprint set
+is invariant by construction. Docs: docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .stats import StepTimelineStats
+
+# event kinds a span may contain, in rough lifecycle order (the JSONL
+# schema table in docs/observability.md mirrors this)
+EVENT_KINDS = (
+    "enqueue",        # scheduler door: request queued (n_prompt, rid)
+    "admit",          # slot leased (slot, queue_ms)
+    "seed",           # prefix-cache seed (hit = tokens seeded)
+    "prefill",        # one prefill chunk dispatched for this row (off, n)
+    "first_token",    # TTFT edge
+    "decode",         # every Nth decode token (n_out)
+    "finish",         # terminal: natural finish (reason, n_out)
+    "error",          # terminal: structured error frame (code, retryable)
+    "route",          # router placement (replica, reason, attempt)
+    "failover",       # retryable pre-stream failure -> re-place (replica,
+    #                   code)
+    "circuit",        # breaker transition (scope=router|engine|spawn,
+    #                   state, replica)
+    "fault",          # an armed fault site actually fired (site, key)
+    "engine_failure",  # supervisor caught a crash/stall (kind, key)
+    "recovery",       # supervisor rebuilt to ready (ms, key)
+    "cluster_lost",   # ClusterPeerLost escalation
+    "worker_exit",    # replica worker process died (replica, cls, rc)
+    "respawn",        # worker respawned to routable (replica, ms)
+    "step",           # scheduler iteration (timeline record)
+)
+
+
+def _sampled(tid: int, rate: float) -> bool:
+    """Deterministic per-request sink sampling: the same trace id is
+    always in or out of the sample, so a span is never half-persisted.
+    Knuth multiplicative hash over the id — ids are sequential, and
+    ``tid % k`` would correlate with placement order."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return ((tid * 2654435761) & 0xFFFFFFFF) / 4294967296.0 < rate
+
+
+class TraceSink:
+    """Rotating JSONL sink for trace events. One file at a time
+    (``trace-00000001.jsonl`` …), rotated past ``max_bytes``, oldest
+    files unlinked past ``max_files`` — a long-lived server's disk
+    footprint is bounded by ``max_bytes * max_files``. Writes are
+    line-buffered under one lock; the caller (Tracer) already decided
+    sampling, so everything handed here is persisted."""
+
+    def __init__(self, directory: str, *, max_bytes: int = 16 << 20,
+                 max_files: int = 8):
+        self.directory = directory
+        self.max_bytes = int(max_bytes)
+        self.max_files = int(max_files)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._n = 0
+        self._seq = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _open_next(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        self._seq += 1
+        path = os.path.join(self.directory,
+                            f"trace-{self._seq:08d}.jsonl")
+        self._fh = open(path, "a", buffering=1)  # line-buffered
+        self._n = self._fh.tell()
+        old = sorted(f for f in os.listdir(self.directory)
+                     if f.startswith("trace-") and f.endswith(".jsonl"))
+        for f in old[:-self.max_files] if len(old) > self.max_files else ():
+            try:
+                os.unlink(os.path.join(self.directory, f))
+            except OSError:
+                pass
+
+    def write(self, line: str) -> None:
+        with self._lock:
+            if self._fh is None or self._n >= self.max_bytes:
+                self._open_next()
+            self._fh.write(line + "\n")
+            self._n += len(line) + 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+class Tracer:
+    """Host-side flight recorder (module singleton: ``TRACER``).
+
+    Disabled by default: hot call sites MUST guard with
+    ``if TRACER.enabled:`` before building event kwargs, which keeps the
+    off path allocation-free (the guard is one attribute read; no dict,
+    no tuple, no call). When enabled, ``event()`` appends one small dict
+    to a bounded ring (``deque.append`` — atomic under the GIL, no lock
+    on the hot path) and optionally persists sampled spans to the JSONL
+    sink. ``step()`` additionally feeds the per-composition step-ms
+    histograms behind /metrics and the bench ``step_timeline`` blocks.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.decode_every = 8     # decode progress event cadence (tokens)
+        self.sample = 1.0         # sink sampling rate (ring records all)
+        self._capacity = 8192
+        self._ring: deque = deque(maxlen=self._capacity)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._sink: TraceSink | None = None
+        self.steps = StepTimelineStats()
+        self.dropped = 0          # ring evictions are implicit; this
+        # counts only sink write failures (disk full etc.)
+        # per-tid span index: by_id/export_span must not scan the whole
+        # ring per completed request (the worker ships a span before
+        # EVERY terminal frame — O(capacity) there scales the pump
+        # thread's latency with --trace-buffer). Span events are
+        # per-lifecycle (a handful per request), so a small lock here
+        # never touches the per-step hot path (tid 0 skips it).
+        self._spans: "dict[int, list]" = {}
+        self._span_order: deque = deque()   # insertion order for eviction
+        self._span_lock = threading.Lock()
+        self._anchor()
+
+    @property
+    def _span_cap(self) -> int:
+        return max(self._capacity // 8, 64)  # distinct live spans
+
+    def _anchor(self) -> None:
+        # one (wall, mono) pair: every stored ts is perf_counter (the
+        # serving stack's single clock domain); wall conversion happens
+        # at export only, so NTP steps can never corrupt an interval
+        self.anchor_mono = time.perf_counter()
+        self.anchor_wall = time.time()
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, *, capacity: int | None = None,
+                  sample: float | None = None,
+                  decode_every: int | None = None,
+                  sink_dir: str | None = None,
+                  sink_max_bytes: int = 16 << 20,
+                  sink_max_files: int = 8,
+                  enabled: bool = True) -> None:
+        """(Re)configure and enable. Reconfiguring replaces the ring (a
+        capacity change cannot preserve eviction order) and the sink."""
+        with self._lock:
+            if capacity is not None:
+                self._capacity = max(int(capacity), 16)
+                self._ring = deque(maxlen=self._capacity)
+                with self._span_lock:
+                    self._spans = {}
+                    self._span_order = deque()
+            if sample is not None:
+                assert 0.0 <= sample <= 1.0, sample
+                self.sample = float(sample)
+            if decode_every is not None:
+                self.decode_every = max(int(decode_every), 1)
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+            if sink_dir is not None:
+                self._sink = TraceSink(sink_dir, max_bytes=sink_max_bytes,
+                                       max_files=sink_max_files)
+            self._anchor()
+            self.enabled = bool(enabled)
+
+    def reset(self) -> None:
+        """Disable and drop all state (test teardown; bench row
+        isolation). The singleton survives — call sites keep their
+        reference."""
+        with self._lock:
+            self.enabled = False
+            self._ring = deque(maxlen=self._capacity)
+            with self._span_lock:
+                self._spans = {}
+                self._span_order = deque()
+            self.steps = StepTimelineStats()
+            self._next_id = 0
+            self.dropped = 0
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+            self._anchor()
+
+    def new_id(self) -> int:
+        """Mint one trace id (sequential, process-local; > 0 so 0 can
+        mean "untraced" on the wire and in event records)."""
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    # -- recording ----------------------------------------------------------
+
+    def event(self, kind: str, tid: int = 0, **fields) -> None:
+        """Append one event. Callers on hot paths guard on ``enabled``
+        BEFORE calling (the kwargs dict is the allocation the disabled
+        path must not pay); this re-check only covers races with a
+        concurrent reset()."""
+        if not self.enabled:
+            return
+        rec = {"ts": time.perf_counter(), "kind": kind, "tid": tid}
+        if fields:
+            rec.update(fields)
+        self._ring.append(rec)  # deque.append: atomic, lock-free
+        if tid:
+            self._index(tid, rec)
+        sink = self._sink
+        if sink is not None and (tid == 0 or _sampled(tid, self.sample)):
+            try:
+                sink.write(json.dumps(
+                    {**rec, "ts_wall": self.to_wall(rec["ts"])}))
+            except (OSError, ValueError):
+                self.dropped += 1
+
+    def step(self, *, decode_rows: int, prefill_rows: int, chunk: int,
+             queue_depth: int, wall_ms: float,
+             key: str | None = None) -> None:
+        """One scheduler iteration: ring record + the per-composition
+        histogram /metrics and the bench knee-search read."""
+        if not self.enabled:
+            return
+        rec = {"ts": time.perf_counter(), "kind": "step", "tid": 0,
+               "dec": decode_rows, "pre": prefill_rows, "chunk": chunk,
+               "queue": queue_depth, "ms": round(wall_ms, 4)}
+        if key is not None:
+            rec["key"] = key
+        self._ring.append(rec)
+        self.steps.record(decode_rows, prefill_rows, chunk, wall_ms)
+        sink = self._sink
+        if sink is not None:
+            try:
+                sink.write(json.dumps(
+                    {**rec, "ts_wall": self.to_wall(rec["ts"])}))
+            except (OSError, ValueError):
+                self.dropped += 1
+
+    def ingest(self, events: list[dict], origin: str,
+               anchor_wall: float | None = None) -> None:
+        """Merge a WORKER PROCESS's span events (RMSG_TRACE payload) onto
+        this tracer's timeline. Worker timestamps arrive as wall-clock
+        (``ts_wall`` — monotonic clocks do not transfer between
+        processes); they are rebased onto this process's perf_counter via
+        the local anchor, so a merged timeline sorts correctly to within
+        host wall-clock resolution (same box: microseconds)."""
+        if not self.enabled:
+            return
+        for e in events:
+            rec = dict(e)
+            wall = rec.pop("ts_wall", None)
+            if wall is None and anchor_wall is not None and "ts" in rec:
+                wall = anchor_wall + rec["ts"]
+            rec["ts"] = (self.anchor_mono + (wall - self.anchor_wall)
+                         if wall is not None else time.perf_counter())
+            rec["origin"] = origin
+            self._ring.append(rec)
+            if rec.get("tid"):
+                self._index(rec["tid"], rec)
+
+    # -- export -------------------------------------------------------------
+
+    def to_wall(self, ts_mono: float) -> float:
+        return self.anchor_wall + (ts_mono - self.anchor_mono)
+
+    def recent(self, n: int = 200) -> list[dict]:
+        """Last n events, oldest first (a snapshot — the ring keeps
+        moving underneath)."""
+        evs = list(self._ring)
+        return evs[-n:] if n else evs
+
+    def _index(self, tid: int, rec: dict) -> None:
+        """Append one span event to the per-tid index (eviction = oldest
+        SPAN past the cap — a span is dropped whole, never truncated)."""
+        with self._span_lock:
+            lst = self._spans.get(tid)
+            if lst is None:
+                while len(self._spans) >= self._span_cap:
+                    old = self._span_order.popleft()
+                    self._spans.pop(old, None)
+                lst = self._spans[tid] = []
+                self._span_order.append(tid)
+            if len(lst) < 1024:
+                # per-span bound: at the default decode cadence (8) this
+                # covers a ~8k-token stream; past it the span keeps its
+                # HEAD (the lifecycle story) and drops further decode
+                # progress — total index memory stays bounded by
+                # span_cap x 1024 regardless of stream lengths
+                lst.append(rec)
+
+    def by_id(self, tid: int) -> list[dict]:
+        """One request's span, in order — the /admin/trace?id=N view and
+        the worker's pre-terminal span ship. Served from the per-tid
+        index, O(span length) not O(ring) (review-found: the O(ring)
+        scan put a per-completed-request cost on the worker's pump
+        thread that scaled with --trace-buffer); a span can therefore
+        outlive its ring entries. Copied under the span lock — a
+        concurrent append must never surface mid-iteration."""
+        with self._span_lock:
+            return list(self._spans.get(tid, ()))
+
+    def export_span(self, tid: int) -> list[dict]:
+        """The span as a cross-process payload: each event gains
+        ``ts_wall`` so the receiving tracer can rebase it (see
+        ``ingest``). Used by the replica worker's RMSG_TRACE frames."""
+        return [{**e, "ts_wall": self.to_wall(e["ts"])}
+                for e in self.by_id(tid)]
+
+    def step_timeline(self) -> dict:
+        """Per-composition step-ms summary (p50/p99/mean/n) — the bench
+        ``step_timeline`` block and the /metrics ``dllama_step_ms``
+        family."""
+        return self.steps.summary()
+
+    def summary(self) -> dict:
+        """The tracer's own observability block (rides /stats when
+        enabled)."""
+        return {"enabled": self.enabled,
+                "events": len(self._ring),
+                "capacity": self._capacity,
+                "next_id": self._next_id,
+                "sample": self.sample,
+                "sink_dropped": self.dropped,
+                "sink": (self._sink.directory
+                         if self._sink is not None else None)}
+
+
+TRACER = Tracer()
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+# /stats summary counters -> Prometheus counters (same payload every tier
+# already emits, so the three serving tiers export identically by
+# construction)
+_COUNTERS = (
+    ("requests_submitted", "dllama_requests_submitted_total",
+     "Requests accepted at the serving door"),
+    ("requests_finished", "dllama_requests_finished_total",
+     "Requests that received a terminal event"),
+    ("requests_failed", "dllama_requests_failed_total",
+     "Requests failed with a structured error frame"),
+    ("requests_expired", "dllama_requests_expired_total",
+     "Requests killed by deadline or queue-time budget"),
+    ("requests_rejected", "dllama_requests_rejected_total",
+     "Requests refused at submit (queue bound)"),
+    ("tokens_out", "dllama_tokens_out_total", "Tokens emitted"),
+    ("steps", "dllama_scheduler_steps_total", "Scheduler iterations"),
+)
+
+_GAUGES = (
+    ("ttft_p50_ms", "dllama_ttft_ms", {"quantile": "0.5"},
+     "Time to first token, sliding window"),
+    ("ttft_p99_ms", "dllama_ttft_ms", {"quantile": "0.99"}, None),
+    ("itl_p50_ms", "dllama_itl_ms", {"quantile": "0.5"},
+     "Inter-token latency, sliding window"),
+    ("itl_p99_ms", "dllama_itl_ms", {"quantile": "0.99"}, None),
+    ("mean_slot_occupancy", "dllama_slot_occupancy_mean", {},
+     "Mean live slots per scheduler iteration (window)"),
+    ("max_queue_depth", "dllama_queue_depth_max", {},
+     "Max admission-queue depth (window)"),
+)
+
+_RESILIENCE = (
+    ("crashes", "dllama_supervisor_crashes_total"),
+    ("watchdog_trips", "dllama_supervisor_watchdog_trips_total"),
+    ("recoveries", "dllama_supervisor_recoveries_total"),
+    ("rejected_unready", "dllama_supervisor_rejected_unready_total"),
+    ("cluster_losses", "dllama_supervisor_cluster_losses_total"),
+)
+
+_ROUTER = (
+    ("routed", "dllama_router_routed_total"),
+    ("routed_cache_hit", "dllama_router_routed_cache_hit_total"),
+    ("routed_affinity", "dllama_router_routed_affinity_total"),
+    ("routed_fallback", "dllama_router_routed_fallback_total"),
+    ("retries", "dllama_router_retries_total"),
+    ("failovers_ok", "dllama_router_failovers_ok_total"),
+    ("midstream_failures", "dllama_router_midstream_failures_total"),
+    ("breaker_trips", "dllama_router_breaker_trips_total"),
+    ("breaker_probes", "dllama_router_breaker_probes_total"),
+    ("no_replica_rejections", "dllama_router_no_replica_rejections_total"),
+)
+
+_PREFIX = (
+    ("lookups", "dllama_prefix_cache_lookups_total"),
+    ("hits", "dllama_prefix_cache_hits_total"),
+    ("tokens_saved", "dllama_prefix_cache_tokens_saved_total"),
+    ("tokens_prefilled", "dllama_prefix_cache_tokens_prefilled_total"),
+    ("blocks_published", "dllama_prefix_cache_blocks_published_total"),
+    ("evictions", "dllama_prefix_cache_evictions_total"),
+    ("publish_drops", "dllama_prefix_cache_publish_drops_total"),
+)
+# blocks_in_use is a LEVEL (drops when blocks free/evict) — emitted as a
+# gauge, never through the counter table: rate() over a shrinking
+# "counter" reads every drop as a counter reset
+_PREFIX_GAUGES = (
+    ("blocks_in_use", "dllama_prefix_cache_blocks_in_use"),
+)
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+class _Prom:
+    """Tiny exposition-format builder: groups samples per metric name so
+    each name gets exactly one # HELP/# TYPE header (the format
+    requirement scrapers enforce)."""
+
+    def __init__(self):
+        self._meta: dict[str, tuple[str, str]] = {}
+        self._samples: dict[str, list[str]] = {}
+
+    def add(self, name: str, value, labels: dict | None = None,
+            help_: str | None = None, type_: str = "gauge") -> None:
+        if value is None:
+            return
+        if name not in self._meta:
+            self._meta[name] = (help_ or name, type_)
+            self._samples[name] = []
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(f'{k}="{_esc(v)}"'
+                                 for k, v in labels.items()) + "}"
+        self._samples[name].append(f"{name}{lab} {value}")
+
+    def render(self) -> str:
+        out = []
+        for name, (help_, type_) in self._meta.items():
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {type_}")
+            out.extend(self._samples[name])
+        return "\n".join(out) + "\n"
+
+
+def _add_block(p: _Prom, block: dict | None, table, *, type_: str,
+               labels: dict | None = None) -> None:
+    if not block:
+        return
+    for row in table:
+        key, name = row[0], row[1]
+        p.add(name, block.get(key), labels=labels, type_=type_)
+
+
+def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
+                      model: str = "dllama", mode: str = "scheduler",
+                      state: str | None = None) -> str:
+    """The GET /metrics body: the /stats summary dict (supervisor- or
+    router-shaped; None while the front door is unbuilt or in legacy
+    mode) + the tracer's step-timeline histograms, as Prometheus text
+    exposition format. Every serving tier hands its EXISTING summary
+    here, so the metric names are tier-invariant and a replica's
+    counters appear both aggregated and per-replica (labelled)."""
+    p = _Prom()
+    p.add("dllama_up", 1, {"model": model, "mode": mode},
+          help_="The serving process is up", type_="gauge")
+    states = ("ready", "recovering", "broken", "draining", "closed",
+              "degraded", "off", "idle")
+    st = state or (summary or {}).get("state")
+    if st is not None:
+        for s in states:
+            p.add("dllama_state", int(st == s), {"state": _esc(s)},
+                  help_="Serving front-door state (one-hot)")
+        if st not in states:
+            p.add("dllama_state", 1, {"state": _esc(st)})
+    if summary:
+        for key, name, help_ in _COUNTERS:
+            p.add(name, summary.get(key), help_=help_, type_="counter")
+        for key, name, labels, help_ in _GAUGES:
+            p.add(name, summary.get(key), labels=labels, help_=help_)
+        _add_block(p, summary.get("prefix_cache"), _PREFIX, type_="counter")
+        _add_block(p, summary.get("prefix_cache"), _PREFIX_GAUGES,
+                   type_="gauge")
+        _add_block(p, summary.get("resilience"), _RESILIENCE,
+                   type_="counter")
+        res = summary.get("resilience") or {}
+        p.add("dllama_supervisor_recovery_ms", res.get("recovery_p50_ms"),
+              {"quantile": "0.5"},
+              help_="Failure-detected to ready-again latency")
+        p.add("dllama_supervisor_recovery_ms", res.get("recovery_p99_ms"),
+              {"quantile": "0.99"})
+        _add_block(p, summary.get("router"), _ROUTER, type_="counter")
+        for rep in summary.get("replicas") or ():
+            lab = {"replica": str(rep.get("replica"))}
+            p.add("dllama_replica_up",
+                  int(rep.get("state") == "ready"
+                      and not rep.get("draining")
+                      and not rep.get("breaker_open")), lab,
+                  help_="Replica is routable")
+            for key, name, help_ in _COUNTERS:
+                p.add(name.replace("dllama_", "dllama_replica_"),
+                      rep.get(key), lab, type_="counter",
+                      help_=help_ and f"{help_} (per replica)")
+            _add_block(p, rep.get("prefix_cache"), tuple(
+                (k, n.replace("dllama_", "dllama_replica_"))
+                for k, n in _PREFIX), type_="counter", labels=lab)
+            _add_block(p, rep.get("prefix_cache"), tuple(
+                (k, n.replace("dllama_", "dllama_replica_"))
+                for k, n in _PREFIX_GAUGES), type_="gauge", labels=lab)
+            proc = rep.get("proc")
+            if proc:
+                p.add("dllama_replica_proc_exits_total", proc.get("exits"),
+                      lab, type_="counter",
+                      help_="Deaths of ready worker processes")
+                p.add("dllama_replica_proc_respawns_total",
+                      proc.get("respawns"), lab, type_="counter")
+                p.add("dllama_replica_proc_spawn_failures_total",
+                      proc.get("spawn_failures"), lab, type_="counter")
+                for cls, n in (proc.get("exit_classes") or {}).items():
+                    p.add("dllama_replica_proc_exit_class_total", n,
+                          {**lab, "class": _esc(cls)}, type_="counter",
+                          help_="Classified worker exits")
+                p.add("dllama_replica_proc_respawn_ms",
+                      proc.get("respawn_p50_ms"),
+                      {**lab, "quantile": "0.5"},
+                      help_="Death-detected to routable-again latency")
+        cluster = summary.get("cluster")
+        if cluster:
+            p.add("dllama_cluster_peers_lost_total",
+                  len(cluster.get("peers_lost") or ()), type_="counter",
+                  help_="Structured ClusterPeerLost detections")
+            p.add("dllama_cluster_pings_sent_total",
+                  cluster.get("pings_sent"), type_="counter")
+            p.add("dllama_cluster_pongs_received_total",
+                  cluster.get("pongs_received"), type_="counter")
+    if tracer is not None and tracer.enabled:
+        t = tracer.summary()
+        p.add("dllama_trace_events", t["events"],
+              help_="Events in the flight-recorder ring")
+        p.add("dllama_trace_next_id", t["next_id"], type_="counter",
+              help_="Trace ids minted")
+        p.add("dllama_trace_sink_dropped_total", t["sink_dropped"],
+              type_="counter")
+        for comp, row in tracer.step_timeline().items():
+            lab = {"decode_rows": str(comp[0]),
+                   "prefill_rows": str(comp[1]), "chunk": str(comp[2])}
+            p.add("dllama_step_ms", row["p50_ms"],
+                  {**lab, "quantile": "0.5"},
+                  help_="Scheduler step wall ms by batch composition")
+            p.add("dllama_step_ms", row["p99_ms"],
+                  {**lab, "quantile": "0.99"})
+            p.add("dllama_steps_by_composition_total", row["n"], lab,
+                  type_="counter",
+                  help_="Scheduler iterations by batch composition")
+    return p.render()

@@ -86,9 +86,9 @@ def _wait_event(proc, name: str, timeout: float) -> tuple[dict, list[str]]:
     sel = selectors.DefaultSelector()
     sel.register(proc.stdout, selectors.EVENT_READ)
     lines: list[str] = []
-    end = time.time() + timeout
+    end = time.monotonic() + timeout
     try:
-        while time.time() < end:
+        while time.monotonic() < end:
             if not sel.select(timeout=0.2):
                 continue
             line = proc.stdout.readline()
@@ -217,6 +217,10 @@ def test_root_sigkill_worker_exits():
     root = _spawn("root", port, "--phases", "formation:0.1,decode:30")
     worker = _spawn("worker", port)
     _, pre_lines = _wait_event(worker, "formed", 60)  # cluster is up
+    # wall clock ON PURPOSE: detect_s below subtracts the subprocess's
+    # own t_wall event stamp — monotonic clocks do not transfer between
+    # processes (the one legitimate cross-process exception to the
+    # monotonic-interval rule, docs/observability.md)
     t_kill = time.time()
     root.send_signal(signal.SIGKILL)
     root.communicate(timeout=10)
@@ -245,10 +249,10 @@ def test_connect_timeout_is_bounded_and_structured():
     """No root at all: the worker must give up at --connect-timeout with a
     structured formation error (exit 44), never spin or hang."""
     port = _free_port()  # nothing listens here
-    t0 = time.time()
+    t0 = time.monotonic()
     worker = _spawn("worker", port, "--connect-timeout", "1.0")
     w_out, w_err = _finish(worker, 20)
-    wall = time.time() - t0
+    wall = time.monotonic() - t0
     assert worker.returncode == EXIT_FORMATION, (w_out, w_err)
     failed = _event(_events(w_out), "formation_failed")
     assert "--connect-timeout" in failed["error"]
@@ -391,11 +395,11 @@ def test_worker_recv_msg_wait_is_supervised():
     worker.form()
     t.join(timeout=10)
     try:
-        t0 = time.time()
+        t0 = time.monotonic()
         root.close()  # root goes away while the worker waits for a frame
         with pytest.raises(mh.ClusterPeerLost) as ei:
             worker.recv(timeout=30.0)
         assert ei.value.node_id == 0
-        assert time.time() - t0 < 5.0  # EOF-fast, nowhere near 30s
+        assert time.monotonic() - t0 < 5.0  # EOF-fast, nowhere near 30s
     finally:
         worker.close()

@@ -158,9 +158,9 @@ def _post_completion(port: int, body: dict, deadline: float = 240.0) -> dict:
     import json
     import time
 
-    t0 = time.time()
+    t0 = time.monotonic()
     last = None
-    while time.time() - t0 < deadline:
+    while time.monotonic() - t0 < deadline:
         try:
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
             conn.request("POST", "/v1/chat/completions", json.dumps(body),

@@ -51,6 +51,7 @@ from distributed_llama_tpu.runtime.resilience import EngineUnready
 from distributed_llama_tpu.runtime.router import RemoteReplicaHandle, Router
 from distributed_llama_tpu.runtime.scheduler import (PromptTooLong,
                                                      RequestError)
+from distributed_llama_tpu.runtime.trace import TRACER
 from distributed_llama_tpu.sampler import Sampler
 
 SEQ = 64
@@ -60,10 +61,15 @@ SEED, SCALE = 3, 0.05
 
 # the worker config every test ships: deterministic synthetic weights
 # (same spec/seed/scale as the oracle below — bit-identical params in
-# both processes), f32 so greedy parity compares bit-exactly
+# both processes), f32 so greedy parity compares bit-exactly. Workers
+# run their own flight recorder (runtime/trace.py) so surviving
+# requests ship worker-side spans back over RMSG_TRACE; with the
+# parent's tracer off (every test but the SIGKILL one) the shipped
+# frames are simply skipped
 CFG = {"test_spec": SPEC_FIELDS, "seed": SEED, "scale": SCALE,
        "compute_dtype": "f32", "batch": 2,
-       "serve": {"stall_timeout": 60.0}}
+       "serve": {"stall_timeout": 60.0},
+       "trace": {"capacity": 2048}}
 
 # the worker subprocess environment: CPU jax, plus the parent's XLA
 # compilation cache so repeat spawns skip the compile cost
@@ -235,7 +241,14 @@ def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
     fails over to the sibling replica and returns BIT-IDENTICAL greedy
     tokens; the service stays ready throughout; and the supervisor
     classifies the SIGKILL and respawns the worker to routable within
-    the bound."""
+    the bound.
+
+    ISSUE 9 rides the same kill: the flight recorder must link the
+    casualty span, the classified exit, and the bit-identical sibling
+    retry as ONE cross-process timeline (the trace id travels in the
+    submit frame; the parent records the casualty itself because a
+    SIGKILLed worker can never ship its span)."""
+    TRACER.configure(capacity=8192)
     # worker-side slow_step paces decode (80 ms/step) so the kill
     # provably lands while streams are in flight
     router = _two_replica_router(
@@ -306,12 +319,79 @@ def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
 
         # the single-replica outage was invisible at the service level
         assert not ready_gaps, f"router went unready at {ready_gaps}"
+
+        # -- the flight-recorder story of the kill (ISSUE 9) ----------
+        # C's span: ONE trace id links route->r0, the replica_lost
+        # casualty (zero tokens), the failover, and the route->r1 retry
+        span_c = TRACER.by_id(req_c.trace_id)
+        kinds_c = [e["kind"] for e in span_c]
+        routes = [e for e in span_c if e["kind"] == "route"]
+        assert [r["replica"] for r in routes] == [0, 1]
+        err_c = next(e for e in span_c if e["kind"] == "error")
+        assert err_c["code"] == "replica_lost" and err_c["n_out"] == 0
+        fo = next(e for e in span_c if e["kind"] == "failover")
+        assert fo["replica"] == 0 and fo["attempt"] == 1
+        assert (kinds_c.index("error") < kinds_c.index("failover")
+                < len(kinds_c) - kinds_c[::-1].index("route"))
+        # A's span: the mid-stream casualty — it streamed (client-side
+        # first_token), then lost its worker mid-request
+        span_a = TRACER.by_id(req_a.trace_id)
+        assert any(e["kind"] == "first_token" for e in span_a)
+        err_a = next(e for e in span_a if e["kind"] == "error"
+                     and e["code"] == "replica_lost")
+        assert err_a["n_out"] >= 1
+        # the kill itself, classified, on the same timeline
+        exits = [e for e in TRACER.recent(0) if e["kind"] == "worker_exit"]
+        assert exits and exits[0]["replica"] == 0
+        assert exits[0]["cls"] == "signal:SIGKILL"
+        # B survived on r1: its worker shipped its span over RMSG_TRACE
+        # — worker-side events (origin worker@...) merged onto the
+        # parent timeline, the cross-process half of the contract
+        span_b = TRACER.by_id(req_b.trace_id)
+        worker_evs = [e for e in span_b if str(e.get("origin",
+                                                     "")).startswith("worker@")]
+        assert any(e["kind"] == "finish" for e in worker_evs)
+        assert any(e["kind"] == "admit" for e in worker_evs)
+
         assert router.stats.midstream_failures == 1
         assert router.stats.retries == 1
         assert router.stats.failovers_ok == 1
+
+        # -- /metrics over the PROCESS tier (the third serving tier of
+        # the ISSUE 9 acceptance bar): the real HTTP handler over this
+        # very router serves valid Prometheus text with the per-replica
+        # process series — including the classified SIGKILL
+        import http.client
+
+        from distributed_llama_tpu.apps.api_server import (ApiState,
+                                                           make_handler)
+        from http.server import ThreadingHTTPServer
+
+        state = ApiState(None, None, None, model_name="procs",
+                         serve_batch=2, replica_procs=2)
+        state._scheduler = router
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            conn = http.client.HTTPConnection(*srv.server_address,
+                                              timeout=60)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            assert 'dllama_up{model="procs",mode="router"} 1' in body
+            assert ('dllama_replica_proc_exit_class_total'
+                    '{replica="0",class="signal:SIGKILL"} 1') in body
+            assert 'dllama_replica_up{replica="1"} 1' in body
+            assert "dllama_router_retries_total 1" in body
+            conn.close()
+        finally:
+            srv.shutdown()
     finally:
         sampling.clear()
         router.close()
+        TRACER.reset()
 
 
 # -- /stats aggregation across a respawn (satellite) -----------------------

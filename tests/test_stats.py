@@ -1,0 +1,141 @@
+"""runtime/stats.py unit tests.
+
+``percentile`` backs every reported p50/p99 in the serving stack (TTFT,
+ITL, recovery and respawn latencies, the step timeline) and had no
+direct tests; the edge cases here pin its nearest-rank semantics —
+deliberately WITHOUT interpolation, so a reported percentile is always
+an observed sample, never an invented midpoint. StepTimelineStats is
+the flight recorder's per-composition histogram (runtime/trace.py).
+"""
+
+from distributed_llama_tpu.runtime.stats import (StepTimelineStats,
+                                                 percentile)
+
+
+# -- percentile -------------------------------------------------------------
+
+
+def test_percentile_empty_is_none():
+    assert percentile([], 50) is None
+    assert percentile([], 0) is None
+    assert percentile([], 100) is None
+
+
+def test_percentile_single_element_answers_every_p():
+    for p in (0, 1, 50, 99, 100):
+        assert percentile([7.5], p) == 7.5
+
+
+def test_percentile_p0_is_min_p100_is_max():
+    xs = [5.0, 1.0, 9.0, 3.0]
+    assert percentile(xs, 0) == 1.0
+    assert percentile(xs, 100) == 9.0
+
+
+def test_percentile_does_not_mutate_input():
+    xs = [3.0, 1.0, 2.0]
+    percentile(xs, 50)
+    assert xs == [3.0, 1.0, 2.0]  # sorted() copy, not .sort()
+
+
+def test_percentile_nearest_rank_no_interpolation():
+    """p50 of two elements is an OBSERVED value (nearest-rank rounds to
+    an index), never the 1.5 linear interpolation would invent."""
+    assert percentile([1.0, 2.0], 50) in (1.0, 2.0)
+    # ten elements 0..9: rank = round(p/100 * 9) — banker's rounding,
+    # so p50 lands on index round(4.5) == 4
+    xs = list(map(float, range(10)))
+    assert percentile(xs, 50) == xs[round(0.5 * 9)] == 4.0
+    assert percentile(xs, 99) == 9.0
+    assert percentile(xs, 10) == xs[round(0.1 * 9)]
+
+
+def test_percentile_out_of_range_p_clamps():
+    xs = [1.0, 2.0, 3.0]
+    assert percentile(xs, -10) == 1.0    # clamped to the min index
+    assert percentile(xs, 250) == 3.0    # clamped to the max index
+
+
+def test_percentile_unsorted_input_and_duplicates():
+    xs = [9.0, 1.0, 9.0, 1.0, 5.0]
+    assert percentile(xs, 0) == 1.0
+    assert percentile(xs, 100) == 9.0
+    assert percentile(xs, 50) == 5.0
+
+
+# -- StepTimelineStats ------------------------------------------------------
+
+
+def test_step_timeline_keys_and_summary():
+    st = StepTimelineStats(window=16)
+    for ms in (1.0, 2.0, 3.0):
+        st.record(4, 1, 16, ms)
+    st.record(2, 0, 0, 10.0)
+    s = st.summary()
+    assert set(s) == {(4, 1, 16), (2, 0, 0)}
+    assert s[(4, 1, 16)]["n"] == 3
+    assert s[(4, 1, 16)]["p50_ms"] == 2.0
+    assert s[(4, 1, 16)]["mean_ms"] == 2.0
+    assert s[(2, 0, 0)]["p99_ms"] == 10.0
+    # busiest composition first
+    assert list(s)[0] == (4, 1, 16)
+    j = st.summary_json()
+    assert j["dec4_pre1_c16"]["n"] == 3  # json-safe string keys
+
+
+def test_step_timeline_window_bounds_samples():
+    st = StepTimelineStats(window=8)
+    for i in range(100):
+        st.record(1, 0, 0, float(i))
+    s = st.summary()[(1, 0, 0)]
+    assert s["n"] == 8
+    assert s["p50_ms"] >= 92.0  # only the newest window survives
+
+
+def test_step_timeline_max_keys_bounds_compositions():
+    st = StepTimelineStats(window=4, max_keys=3)
+    for k in range(10):
+        st.record(k, 0, 0, 1.0)
+    assert len(st.summary()) == 3
+    assert st.overflow == 7
+    # an EXISTING key still records past the cap
+    st.record(0, 0, 0, 2.0)
+    assert st.summary()[(0, 0, 0)]["n"] == 2
+
+
+def test_step_timeline_thread_safety_smoke():
+    import threading
+
+    st = StepTimelineStats(window=1024)
+    errs = []
+
+    def hammer(k):
+        try:
+            for i in range(500):
+                st.record(k % 4, 0, 0, float(i))
+                if i % 50 == 0:
+                    st.summary()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs
+    assert sum(v["n"] for v in st.summary().values()) <= 4 * 500
+
+
+def test_percentile_matches_served_usage_shape():
+    """The integration shape: percentile over a deque window exactly as
+    ServeStats.summary does (list() of a deque of floats)."""
+    from collections import deque
+
+    win = deque(maxlen=4)
+    for v in (10.0, 20.0, 30.0, 40.0, 50.0):
+        win.append(v)
+    assert percentile(list(win), 50) in (30.0, 40.0)
+    assert percentile(list(win), 100) == 50.0
+    # falsy inputs (None, ()) take the same no-data path as []
+    assert percentile(None, 50) is None
