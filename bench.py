@@ -232,6 +232,25 @@ def _measure_decode(engine, n_tokens: int, fill: int, repeats: int) -> float:
     return dt / n_tokens * 1e3
 
 
+# hbm-block plumbing (ISSUE-10 satellite): row functions that build an
+# engine note it here; _with_step_timeline attaches the ledger next to
+# step_timeline on every emitted row. A box, not a parameter, because
+# the engines live deep inside the row functions.
+_HBM_BOX: dict = {}
+
+
+def _note_hbm(engine, prefix_cache=None) -> None:
+    """Record the hbm ledger (runtime/profiler.hbm_ledger) of the row's
+    engine — called while the engine's arrays are still live."""
+    from distributed_llama_tpu.runtime.profiler import hbm_ledger
+
+    try:
+        _HBM_BOX["hbm"] = hbm_ledger(engine, prefix_cache)
+    except Exception as e:  # noqa: BLE001 — a ledger bug must never
+        _HBM_BOX["hbm"] = {"error": f"{type(e).__name__}: {e}"}  # kill a
+        # measured row
+
+
 def _with_step_timeline(row_fn, *args, **kwargs) -> dict:
     """Run one bench row with the flight recorder on and attach the
     per-batch-composition step-ms summary (the ISSUE-9 satellite: every
@@ -245,12 +264,17 @@ def _with_step_timeline(row_fn, *args, **kwargs) -> dict:
     # decode_every huge: the serving rows only need STEP records here —
     # span events would grow the ring without changing the block
     TRACER.configure(capacity=4096, decode_every=1 << 30)
+    _HBM_BOX.pop("hbm", None)
     try:
         row = row_fn(*args, **kwargs)
     finally:
         timeline = TRACER.steps.summary_json()
         TRACER.reset()
     row["step_timeline"] = timeline
+    # the hbm ledger the row noted while its engine was live (empty for
+    # rows without one — the cluster control-plane row; the procs row
+    # merges WORKER-side ledgers itself)
+    row.setdefault("hbm", _HBM_BOX.pop("hbm", {}))
     return row
 
 
@@ -554,6 +578,7 @@ def _serve_row(params, spec: ModelSpec, prefix: str, b: int = 8) -> dict:
 
     eng = Engine(spec, params, compute_dtype=cdt, cache_dtype=cdt,
                  max_seq_len=seq, batch=b)
+    _note_hbm(eng)
 
     def greedy():
         return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=7)
@@ -731,6 +756,7 @@ def _prefix_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
     outs_off, ttft_off = run_trace(None)
     pc = PrefixCache(eng, num_blocks=max(2 * b * seq // bl,
                                          sys_len // bl + 8), block_len=bl)
+    _note_hbm(eng, pc)  # the cache-ON shape: slots + the real arena
     outs_on, ttft_on = run_trace(pc)
 
     s = pc.stats.summary()
@@ -807,6 +833,7 @@ def _chaos_row(params, spec: ModelSpec, prefix: str, b: int = 4) -> dict:
 
     sup = EngineSupervisor(factory, chunk=32, stall_timeout=60.0,
                            backoff_base=0.05, breaker_threshold=10_000)
+    _note_hbm(sup.engine, sup.prefix_cache)
 
     def greedy():
         return Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=7)
@@ -984,6 +1011,9 @@ def _router_row(params, spec: ModelSpec, prefix: str, b: int = 2) -> dict:
                         chunk=bl, stall_timeout=60.0, backoff_base=0.05,
                         breaker_threshold=10_000, circuit_threshold=10_000,
                         prefix_blocks=blocks, prefix_block_len=bl)
+        h0 = router.replicas[0]
+        _note_hbm(h0.sup.engine, h0.sup.prefix_cache)  # one replica's
+        # exact shape (siblings are identical and SHARE the weights)
         outs: dict = {}
         errs: dict = {}
         ready_samples: list = []
@@ -1285,10 +1315,15 @@ def _router_procs_row(prefix: str) -> dict:
         # the stats reply carries each worker's summary) — keyed per
         # replica so two workers' compositions never merge
         step_timeline = {}
+        hbm = {}
         for h in handles:
             s = (h.client.stats_summary() or {}) if h is not None else {}
             for k, v in (s.get("step_timeline") or {}).items():
                 step_timeline[f"r{h.id}_{k}"] = v
+            # per-WORKER hbm ledgers off the same stats reply (each
+            # process owns its weights — no shared-buffer caveat here)
+            if s.get("hbm"):
+                hbm[f"r{h.id}"] = s["hbm"]
         router.close()
         gc.collect()
 
@@ -1302,6 +1337,8 @@ def _router_procs_row(prefix: str) -> dict:
         "value": (None if kill_to_routable_ms is None
                   else round(kill_to_routable_ms, 1)),
         "unit": "ms", "vs_baseline": None,
+        "hbm": hbm,  # per-WORKER ledgers, rK-keyed (this row is emitted
+        # outside _with_step_timeline — it builds its own blocks)
         "mode": "process", "replicas": 2, "requests": n_req,
         "decode_step_ms": step_ms,
         "kill_to_routable_ms": (None if kill_to_routable_ms is None
@@ -1606,12 +1643,17 @@ def main() -> None:
             max_seq_len=seq)
 
         repeats = int(os.environ.get("BENCH_REPEATS", "2"))
-        main_row = _with_step_timeline(
-            lambda: _decode_row(
+
+        def _main():
+            row = _decode_row(
                 metric, spec, _measure_decode(engine, n_tokens, fill,
                                               repeats),
                 fill=fill, n_tokens=n_tokens,
-                cache_itemsize=jnp.dtype(cache_dtype).itemsize, base=base))
+                cache_itemsize=jnp.dtype(cache_dtype).itemsize, base=base)
+            _note_hbm(engine)
+            return row
+
+        main_row = _with_step_timeline(_main)
         ms_per_token = main_row["value"]
         out.update(main_row)
         if model in ("moe", "grok", "70bt"):

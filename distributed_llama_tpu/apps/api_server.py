@@ -111,7 +111,8 @@ class ApiState:
                  retry_budget: int = 1, route_policy: str = "cache_aware",
                  replica_procs: int = 0, replica_hosts=None,
                  worker_config: dict | None = None,
-                 admin_token: str | None = None):
+                 admin_token: str | None = None,
+                 profile_dir: str | None = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -179,6 +180,21 @@ class ApiState:
         # plane detects a dead/wedged worker — /readyz answers 503
         # cluster_lost during the brief window before the diagnostic exit
         self.cluster_lost = None
+        # POST /admin/profile capture home (--profile-dir; a tempdir per
+        # capture otherwise) and the cached build-identity block every
+        # /healthz + /metrics answer carries
+        self.profile_dir = profile_dir
+        self._build_info: dict | None = None
+
+    def build_info(self) -> dict:
+        """{version, jax, backend, mesh} — computed once (the backend
+        and mesh never change within a process), served on /healthz
+        (`build` block) and /metrics (`dllama_build_info`)."""
+        if self._build_info is None:
+            from ..runtime.profiler import build_info
+
+            self._build_info = build_info(self.engine)
+        return self._build_info
 
     def scheduler(self):
         """The serving front door, built and started on first use: an
@@ -801,9 +817,14 @@ def make_handler(state: ApiState):
                 # liveness: the process is up and serving HTTP — true even
                 # while the engine recovers (that is /readyz's business) or
                 # the server drains (it reports so, but stays 200: a
-                # liveness-restart would cut the drain short)
+                # liveness-restart would cut the drain short). The build
+                # block answers in EVERY tier (never 404s off a launch
+                # flag — the PR-8 rule): version skew across a replica
+                # fleet is an outage class, and the probe everyone
+                # already scrapes is where it must show.
                 self._json(200, {"status": "draining" if state.draining
-                                 else "ok"})
+                                 else "ok",
+                                 "build": state.build_info()})
             elif self.path == "/readyz":
                 self._readyz()
             elif self.path == "/stats":
@@ -864,12 +885,34 @@ def make_handler(state: ApiState):
                 else:
                     payload, st = state._scheduler.summary(), None
             cluster = cluster_summary()
+            payload = dict(payload or {})
             if cluster is not None:
-                payload = dict(payload or {})
                 payload["cluster"] = cluster
+            # device-tier blocks for the tiers whose summary has none:
+            # the compile ledger is process-global (legacy engines mint
+            # through it too — the supervisor summary carries the same
+            # singleton), and on NON-router tiers the engine's HBM is
+            # live memory worth scraping. Router tiers deliberately
+            # carry NO top-level hbm (runtime/router.Router.summary —
+            # per-replica blocks are the truth there; state.engine is
+            # an idle template whose headroom would mislead the batch
+            # auto-sizing).
+            if "compiles" not in payload:
+                from ..runtime.profiler import COMPILES
+
+                payload["compiles"] = COMPILES.summary()
+            if ("hbm" not in payload and state.engine is not None
+                    and not state.router_mode):
+                from ..runtime.profiler import hbm_ledger
+
+                try:
+                    payload["hbm"] = hbm_ledger(state.engine)
+                except Exception:  # noqa: BLE001 — a weightless front
+                    pass           # template has no ledger-able arrays
             data = render_prometheus(payload, tracer=TRACER,
                                      model=state.model_name, mode=mode,
-                                     state=st).encode()
+                                     state=st,
+                                     build=state.build_info()).encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
@@ -897,19 +940,47 @@ def make_handler(state: ApiState):
                 self._json(404, {"error": "tracing off (start with "
                                           "--trace)"})
                 return
+            from ..runtime.trace import EVENT_KINDS
+
             try:
-                q = parse_qs(urlparse(self.path).query)
+                # keep_blank_values: "kind=" must be rejected as garbage
+                # below, not silently dropped into an unfiltered dump
+                q = parse_qs(urlparse(self.path).query,
+                             keep_blank_values=True)
                 tid = int(q["id"][0]) if "id" in q else None
                 n = int(q.get("n", ["200"])[0])
                 if n < 0 or (tid is not None and tid < 0):
                     # a negative n would slice the WRONG end of the ring
                     # (evs[-n:] == evs[n:]) — reject, don't dump
                     raise ValueError(n)
+                # kind= / since_ms= filters: validated, 400 on garbage —
+                # a typo'd kind must not silently return an empty (or
+                # unfiltered) dump an operator then misreads
+                kind = q["kind"][0] if "kind" in q else None
+                if kind is not None and kind not in EVENT_KINDS:
+                    raise ValueError(kind)
+                since_ms = (float(q["since_ms"][0]) if "since_ms" in q
+                            else None)
+                if since_ms is not None and not since_ms >= 0:
+                    # `not >=` also rejects NaN, which every ts compare
+                    # below would silently pass
+                    raise ValueError(since_ms)
             except (ValueError, IndexError):
                 self._json(400, {"error": "bad request"})
                 return
+            filtered = kind is not None or since_ms is not None
+            # with filters on, filter over the WHOLE ring then tail n —
+            # slicing first would make n pre-filter events, so a sparse
+            # kind could return nothing even though matches exist
             events = TRACER.by_id(tid) if tid is not None \
-                else TRACER.recent(n)
+                else TRACER.recent(0 if filtered else n)
+            if kind is not None:
+                events = [e for e in events if e.get("kind") == kind]
+            if since_ms is not None:
+                cut = time.perf_counter() - since_ms / 1e3
+                events = [e for e in events if e.get("ts", 0.0) >= cut]
+            if tid is None and filtered and n:
+                events = events[-n:]
             lines = [json.dumps({"anchor_wall": TRACER.anchor_wall,
                                  "anchor_mono": TRACER.anchor_mono,
                                  "events": len(events)})]
@@ -1018,6 +1089,12 @@ def make_handler(state: ApiState):
                                           "or a valid --admin-token "
                                           "bearer"})
                 return
+            if (self.path == "/admin/profile"
+                    or self.path.startswith("/admin/profile?")):
+                # on-demand capture: ALL tiers, legacy included — routed
+                # before the supervised-scheduler checks below
+                self._admin_profile()
+                return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -1075,6 +1152,62 @@ def make_handler(state: ApiState):
                     self._json(200, {"status": "ok", "replica": replica})
             else:
                 self._json(404, {"error": "not found"})
+
+        def _admin_profile(self) -> None:
+            """POST /admin/profile?ms=N — write one jax.profiler trace of
+            the next N milliseconds (docs/observability.md "Device
+            tier"). Synchronous: the 200 means the trace is on disk
+            (the threaded accept loop keeps serving meanwhile). On the
+            process tier the verb relays as RMSG_PROFILE into every
+            replica worker — each captures into its own per-worker dir,
+            concurrently, and the response lists them; otherwise the
+            capture runs in THIS process (legacy, supervisor, and
+            thread-router tiers all share one jax runtime). 409 when a
+            capture is already running (jax.profiler is process-global).
+            Admin-guarded like every /admin/* verb — a trace names every
+            op and shape on the box."""
+            import os
+            import tempfile
+            from urllib.parse import parse_qs, urlparse
+
+            try:
+                q = parse_qs(urlparse(self.path).query)
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                ms = (float(q["ms"][0]) if "ms" in q
+                      else float(body.get("ms", 100.0)))
+                if not 0.0 < ms <= 60_000.0:  # also rejects NaN
+                    raise ValueError(ms)
+            except (ValueError, TypeError, json.JSONDecodeError):
+                self._json(400, {"error": "bad request: ms must be in "
+                                          "(0, 60000]"})
+                return
+            sup = state._scheduler
+            if sup is None and (state.replica_procs
+                                or state.replica_hosts):
+                # process tier, front door unbuilt: the device work
+                # lives in workers that don't exist yet — answer idle
+                # like the other admin verbs, never a 200 over a
+                # parent-only (deviceless) capture
+                self._json(200, {"status": "idle"})
+                return
+            if sup is not None and hasattr(sup, "profile"):
+                workers = sup.profile(ms)  # Router: RMSG_PROFILE relay
+                if workers is not None:    # None = no remote replicas
+                    self._json(200, {"status": "ok", "ms": ms,
+                                     "workers": workers})
+                    return
+            from ..runtime.profiler import PROFILER
+
+            base = state.profile_dir or tempfile.mkdtemp(prefix="dlprof-")
+            target = os.path.join(base,
+                                  f"profile-{int(time.time() * 1e3):x}")
+            try:
+                out = PROFILER.capture(target, ms)
+            except RuntimeError as e:  # a capture is already running
+                self._json(409, {"error": str(e)}, retry_after=ms / 1e3)
+                return
+            self._json(200, {"status": "ok", **out})
 
         def _batch_post(self, body: dict) -> None:
             """POST /v1/batch/completions — up to serve_batch prompts in one
@@ -1398,6 +1531,27 @@ def serve(args) -> None:
             sample=1.0 if sample is None else float(sample),
             decode_every=getattr(args, "trace_decode_every", None) or 8,
             sink_dir=getattr(args, "trace_dir", None))
+    # device-tier observability (runtime/profiler.py): the recompile
+    # sentinel's freeze and the sampled attribution both hang off the
+    # slot scheduler (warmup arms the sentinel; the sampler hooks
+    # scheduler steps) — without --serve-batch they are dead flags
+    freeze_compiles = bool(getattr(args, "freeze_compiles", False))
+    profile_sample = getattr(args, "profile_sample", None)
+    if (freeze_compiles or profile_sample is not None) and not serve_batch:
+        sys.exit("error: --freeze-compiles/--profile-sample require "
+                 "--serve-batch N (the sentinel arms at scheduler "
+                 "warmup; the sampler hooks scheduler steps)")
+    if profile_sample is not None and profile_sample < 1:
+        sys.exit("error: --profile-sample must be >= 1 (capture every "
+                 "Nth step; omit the flag to disable)")
+    if freeze_compiles or profile_sample:
+        from ..runtime.profiler import COMPILES, PROFILER
+
+        COMPILES.freeze = freeze_compiles
+        # on the process tier the WORKERS sample (config_from_cli_args
+        # ships both knobs); setting the parent too is harmless — it
+        # steps no scheduler
+        PROFILER.sample_every = int(profile_sample or 0)
     replica_hosts = None
     if replica_hosts_raw:
         replica_hosts = []
@@ -1453,7 +1607,8 @@ def serve(args) -> None:
                      replica_procs=replica_procs,
                      replica_hosts=replica_hosts,
                      worker_config=worker_config,
-                     admin_token=getattr(args, "admin_token", None))
+                     admin_token=getattr(args, "admin_token", None),
+                     profile_dir=getattr(args, "profile_dir", None))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "

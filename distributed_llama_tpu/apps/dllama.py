@@ -288,6 +288,30 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="decode progress event cadence in tokens "
                         "(default 8) — bounds how much ring one long "
                         "stream can occupy")
+    # device-tier observability (runtime/profiler.py,
+    # docs/observability.md "Device tier"): compile ledger + recompile
+    # sentinel, HBM ledger, on-demand capture, sampled attribution
+    p.add_argument("--freeze-compiles", action="store_true",
+                   help="api mode (needs --serve-batch): after warmup "
+                        "compiles the serving set, any NEW compile key "
+                        "is refused with a structured error instead of "
+                        "compiled — the runtime twin of dlgrind's "
+                        "static fingerprint gate. Covers everything "
+                        "minted post-warmup, including the batch "
+                        "endpoint's whole-batch executables (warm those "
+                        "shapes first or leave the freeze off; "
+                        "docs/operations.md 'Recompile storms')")
+    p.add_argument("--profile-sample", type=int, default=None, metavar="N",
+                   help="api mode (needs --serve-batch): capture every "
+                        "Nth scheduler step under a short jax.profiler "
+                        "trace and attribute device ms per entry point "
+                        "(/stats device_time block, dllama_device_ms "
+                        "/metrics). Off by default — disabled it costs "
+                        "nothing, like --trace")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="where POST /admin/profile captures land "
+                        "(default: a fresh temp dir per capture; replica "
+                        "workers write worker-rK/ subdirs)")
     # multi-host cluster flags (the reference's root + worker nodes,
     # ref: src/app.cpp:51-74; here one jax.distributed SPMD cluster)
     p.add_argument("--nnodes", type=int, default=1,

@@ -273,10 +273,41 @@ class Engine:
         self._multihost = is_multihost(mesh)
         self._replicator = None
 
-        self._cache_maker = None
+        # compile-cache + ledger state BEFORE the first mint (_new_cache
+        # below jits the cache maker): every executable this engine ever
+        # builds routes through _mint, and _compile_warm arms the
+        # recompile sentinel once Scheduler.warmup() has compiled the
+        # serving set (runtime/profiler.py)
+        self._steps: dict[int | tuple[str, int], Callable] = {}
+        self._compile_warm = False
         self.cache = self._new_cache()
         self.pos = 0
-        self._steps: dict[int | tuple[str, int], Callable] = {}
+
+    # -- compile ledger ----------------------------------------------------
+
+    def _mint(self, key, fn: Callable) -> Callable:
+        """Register one freshly-jitted executable under `key`, routed
+        through the compile ledger (runtime/profiler.py): the first call
+        is timed as the compile (entry key, wall ms) and — on a warm
+        engine — trips the recompile sentinel (a structured error under
+        --freeze-compiles, BEFORE the compile runs). The watch swaps the
+        raw jitted callable back into _steps after that first call, so
+        the steady-state hot path is byte-for-byte the pre-ledger one.
+        Host-side bookkeeping only: the jitted program (and dlgrind's
+        fingerprint of it) is untouched."""
+        from .profiler import COMPILES
+
+        wrapped = COMPILES.watch(self, key, fn)
+        self._steps[key] = wrapped
+        return wrapped
+
+    def mark_compile_warm(self) -> None:
+        """Arm the recompile sentinel: the serving set is compiled
+        (Scheduler.warmup calls this last), so from here every new
+        compile key is a `compile_after_warmup` event — and, frozen, a
+        structured refusal. Per ENGINE: a supervisor rebuild mints a
+        fresh engine whose own warmup legitimately recompiles."""
+        self._compile_warm = True
 
     # -- cache ------------------------------------------------------------
 
@@ -288,17 +319,17 @@ class Engine:
         # transient full-size cache on one device (matters for sp-sharded
         # long-context caches). The jitted maker is built once: reset() is a
         # server hot path (per-request) and must not retrace.
-        if self._cache_maker is None:
+        if "cache_maker" not in self._steps:
             n_l = self.spec.n_layers
             if self._pp > 1:  # stage-stacked: n_layers/pp leaves (pp, ...)
                 n_l //= self._pp
             shardings = KVCache((self._cache_sharding,) * n_l,
                                 (self._cache_sharding,) * n_l)
-            self._cache_maker = jax.jit(
+            self._mint("cache_maker", jax.jit(
                 lambda: KVCache.create(self.spec, self.batch, self.seq_len,
                                        self.cache_dtype, pp=self._pp),
-                out_shardings=shardings)
-        return self._cache_maker()
+                out_shardings=shardings))
+        return self._steps["cache_maker"]()
 
     def reset(self) -> None:
         """New session: rewind position (the API server resets per request,
@@ -373,10 +404,17 @@ class Engine:
         # donation-safety fix and the f8 NaN-code guard)
         shape = (self.batch, self.spec.n_kv_heads, self.seq_len,
                  self.spec.head_size)
-        build = self._seed_jit(
+        # ledger-watched but NOT cached in _steps: each restore builds a
+        # fresh closure (no reuse across calls is possible), so storing
+        # it would only pin one dead executable per distinct pos for the
+        # engine's lifetime — the watch alone records the compile
+        from .profiler import COMPILES
+
+        build = COMPILES.watch(self, ("session_restore", pos),
+                               self._seed_jit(
             lambda pfx: jnp.zeros(shape, dt).at[:, :, :pos, :].set(
                 self._seed_guard(pfx)),
-            out_tree=0)
+            out_tree=0))
         k_all, v_all = [], []
         for l in range(self.spec.n_layers):
             k_all.append(build(z[f"k{l}"].view(dt)))
@@ -597,9 +635,7 @@ class Engine:
             else f"prefill_chunk_{key[1]}" if key[0] == "prefill"
             else "verify_step" if key[0] == "lookup"
             else "batch_decode_step")
-        fn = jax.jit(run, donate_argnums=(3,))
-        self._steps[key] = fn
-        return fn
+        return self._mint(key, jax.jit(run, donate_argnums=(3,)))
 
     def _step_fn(self, t: int) -> Callable:
         return self._compiled_step(t)
@@ -632,9 +668,9 @@ class Engine:
         lock-step invariant, parallel/multihost.py)."""
         if self._multihost and not logits.is_fully_replicated:
             if self._replicator is None:
-                self._replicator = jax.jit(
+                self._replicator = self._mint("replicator", jax.jit(
                     lambda l: l,
-                    out_shardings=NamedSharding(self.mesh, P()))
+                    out_shardings=NamedSharding(self.mesh, P())))
             logits = self._replicator(logits)
         return np.asarray(logits)
 
@@ -1053,7 +1089,7 @@ class Engine:
                                logit_index=logit_index, **common)
 
             run.__name__ = f"slot_prefill_chunk_{c}"
-            self._steps[key] = jax.jit(run, donate_argnums=(4,))
+            self._mint(key, jax.jit(run, donate_argnums=(4,)))
         tok = jnp.asarray(tokens, jnp.int32)
         posv = jnp.asarray(pos, jnp.int32)
         if self._token_sharding is not None:
@@ -1083,7 +1119,7 @@ class Engine:
                                **common)
 
             run.__name__ = "slot_decode_step"
-            self._steps[key] = jax.jit(run, donate_argnums=(3,))
+            self._mint(key, jax.jit(run, donate_argnums=(3,)))
         tok = jnp.asarray(tokens, jnp.int32)
         posv = jnp.asarray(pos, jnp.int32)
         if self._token_sharding is not None:
@@ -1112,8 +1148,8 @@ class Engine:
         dt = self.cache_dtype
         key = ("prefix_arena", shape)
         if key not in self._steps:
-            self._steps[key] = jax.jit(
-                lambda: (jnp.zeros(shape, dt), jnp.zeros(shape, dt)))
+            self._mint(key, jax.jit(
+                lambda: (jnp.zeros(shape, dt), jnp.zeros(shape, dt))))
         return self._steps[key]()
 
     def slot_seed_prefix(self, arena_k, arena_v, row: int,
@@ -1132,8 +1168,8 @@ class Engine:
         key = ("slot_seed", mb, bl)
         if key not in self._steps:
             run = seed_rows_from_blocks
-            self._steps[key] = self._seed_jit(run, out_tree=self.cache,
-                                              donate=(0,))
+            self._mint(key, self._seed_jit(run, out_tree=self.cache,
+                                           donate=(0,)))
         self.cache = self._steps[key](
             self.cache, arena_k, arena_v, jnp.int32(row),
             jnp.asarray(block_ids, jnp.int32))
@@ -1166,7 +1202,7 @@ class Engine:
                 return tuple(outs)
 
             run.__name__ = "slot_publish_block"
-            self._steps[key] = jax.jit(run, donate_argnums=(0, 1))
+            self._mint(key, jax.jit(run, donate_argnums=(0, 1)))
         return self._steps[key](arena_k, arena_v, self.cache,
                                 jnp.int32(row), jnp.int32(offset),
                                 jnp.int32(dst))
@@ -1223,10 +1259,10 @@ class Engine:
         # batch-lookup bench at 59 tok/s aggregate; (B, T) int32 is ~256 B
         amax_key = ("bl_amax", spec_v)
         if amax_key not in self._steps:
-            self._steps[amax_key] = jax.jit(
+            self._mint(amax_key, jax.jit(
                 lambda l: jnp.argmax(
                     l[..., :spec_v].astype(jnp.float32), axis=-1
-                ).astype(jnp.int32))
+                ).astype(jnp.int32)))
         amax = self._steps[amax_key]
 
         # whole-batch right-padded prefill (same path as generate_batch)
@@ -1552,7 +1588,7 @@ class Engine:
                      jnp.bool_(False)))
                 return buf, n, cache
 
-            self._steps[key] = run
+            self._mint(key, run)
 
         toks, n, self.cache = self._steps[key](
             self.params, logits, jnp.int32(self.pos), self.cache,
@@ -1670,7 +1706,7 @@ class Engine:
                      jnp.int32(0), jnp.zeros((b,), bool)))
                 return buf, n, cache
 
-            self._steps[key] = run
+            self._mint(key, run)
 
         posv = jnp.asarray(lens)
         rng0 = jnp.stack([state_from_seed(seed + i) for i in range(b)])
@@ -1716,7 +1752,7 @@ class Engine:
                     body, (tok0, pos0, cache), None, length=n_tokens)
                 return toks, cache
 
-            self._steps[key] = run
+            self._mint(key, run)
             warm = True
         else:
             warm = False

@@ -247,6 +247,45 @@ def per_step_op_ms(trace_dir: str, markers: tuple = COLLECTIVE_MARKERS,
     return []
 
 
+def per_module_ms(trace_dir: str) -> dict:
+    """Parse a jax.profiler trace into PER-ENTRY-POINT summed device time:
+    {module name: total ms across its executions in the trace}. The same
+    ProfileData walk as :func:`per_step_op_ms`, but keyed by module NAME
+    instead of bucketing op events into execution spans — this is the
+    attribution the sampled step profiler (runtime/profiler.py) records:
+    the engine names every jitted wrapper by role (``slot_decode_step``,
+    ``slot_prefill_chunk_16``, ``prefill_seg`` ... — Engine._compiled_step),
+    so the XLA Modules line's event names map straight onto serving
+    entry points. Returns {} when the trace has no device plane (CPU
+    backends emit host planes only) — the caller treats attribution as
+    best-effort."""
+    import glob
+
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:  # older jax without the xplane parser
+        return {}
+    files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
+    if not files:
+        return {}
+    pd = ProfileData.from_file(files[-1])
+    out: dict[str, float] = {}
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for ln in plane.lines:
+            if ln.name != "XLA Modules":
+                continue
+            for e in ln.events:
+                # module names arrive as e.g. "jit_slot_decode_step(...)"
+                # or with an id suffix — strip to the stable stem
+                name = e.name.split("(")[0]
+                if name.startswith("jit_"):
+                    name = name[4:]
+                out[name] = out.get(name, 0.0) + e.duration_ns / 1e6
+    return {k: round(v, 4) for k, v in out.items()}
+
+
 def measure_allreduce_ms(mesh, payload_elems: int, iters: int = 16,
                          axes: tuple = ("tp",)) -> float:
     """Time one f32 all-reduce of `payload_elems` over the given mesh axes

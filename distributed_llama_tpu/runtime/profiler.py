@@ -1,0 +1,541 @@
+"""Device-tier observability: compile ledger, HBM ledger, profiler capture.
+
+PR 8's flight recorder (runtime/trace.py) made the HOST side legible —
+spans, /metrics, the per-iteration step timeline — but the device stayed
+a black box: nothing watched for post-warmup recompiles at runtime
+(dlgrind's fingerprint gate is static-only), nobody accounted HBM by
+category (the number ROADMAP item 1 needs to auto-size ``--serve-batch``
+and ``--prefix-blocks``), and device time was attributable only by
+hand-running ``jax.profiler`` offline. This module is the device half:
+
+  * **Compile ledger + recompile sentinel** (``COMPILES``) — every
+    executable the engine mints routes through :meth:`CompileLedger.watch`
+    (``Engine._mint``), which times the first call (trace + compile wall
+    ms) and records (key, wall ms, count). After ``Scheduler.warmup()``
+    marks an engine's serving set warm, any NEW compile key emits a
+    ``compile_after_warmup`` trace event + counter — the runtime twin of
+    dlgrind's static fingerprint gate — and, under ``--freeze-compiles``,
+    raises a structured ``RequestError`` BEFORE the compile runs. The
+    ledger exports the ``dllama_compiles_total`` / ``dllama_compile_ms``
+    /metrics families and the ``compiles`` /stats block, in every tier
+    (replica workers run their own ledger; its block rides their stats
+    reply like every other per-replica block).
+  * **HBM ledger** (:func:`hbm_ledger`) — per-category live bytes from
+    the engine's KNOWN array shapes (weights / KV slot cache / prefix
+    arena / logits+workspace), reconciled against
+    ``device.memory_stats()`` where the backend provides it (TPU/GPU;
+    CPU test runs report the exact shape-derived bytes with device
+    fields null), plus the headroom estimate — ``slots_addable`` /
+    ``prefix_blocks_addable`` — that item 1's auto-sizing consumes.
+    Exported as ``dllama_hbm_bytes{category=}`` gauges, the ``hbm``
+    /stats block, and a block on every BENCH row.
+  * **On-demand capture** (:meth:`Profiler.capture`) — the
+    ``POST /admin/profile?ms=`` body: one bounded ``jax.profiler`` trace
+    written to a directory, refusals instead of concurrent captures
+    (``jax.profiler`` is process-global). ``RMSG_PROFILE`` relays the
+    verb into replica worker processes (per-worker capture dirs).
+  * **Sampled device-time attribution** (:meth:`Profiler.step_begin` /
+    ``step_end``) — every ``--profile-sample``-th scheduler step runs
+    under a short ``jax.profiler`` trace parsed by ``netstats``'
+    ProfileData reader into per-entry-point device ms (the engine's
+    role-specific wrapper names: ``slot_decode_step``,
+    ``slot_prefill_chunk_16``, ...). Disabled (the default) it is
+    allocation-free like the tracer: call sites guard on
+    ``PROFILER.sample_every`` before calling anything.
+
+Everything here is host code running strictly pre/post device dispatch —
+no jitted program changes, and the dlgrind fingerprint set is invariant
+by construction (the watch wrapper swaps itself out of ``Engine._steps``
+after the first call, so the steady-state hot path is the raw jitted
+callable again). Docs: docs/observability.md ("Device tier").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .trace import TRACER
+
+# -- compile ledger ---------------------------------------------------------
+
+
+def _key_elem(x) -> str:
+    if isinstance(x, tuple):  # nested shape/stop-id tuples: 16x2x4
+        return "x".join(_key_elem(e) for e in x)
+    return str(x)
+
+
+def compile_key_str(key) -> str:
+    """Engine compile-cache key -> a bounded, label-safe string (the
+    ``key=`` label of ``dllama_compiles_total``). Tuple keys join with
+    ':' (nested tuples with 'x'); bare ints are forward-segment widths;
+    anything outside [0-9A-Za-z_:.x-] flattens to '_' so the string is
+    a clean Prometheus label value and JSONL field."""
+    import re
+
+    if isinstance(key, tuple):
+        s = ":".join(_key_elem(x) for x in key)
+    elif isinstance(key, int):
+        s = f"seg:{key}"
+    else:
+        s = str(key)
+    return re.sub(r"[^0-9A-Za-z_:.x-]", "_", s)[:120]
+
+
+class _CompileWatch:
+    """First-call timer around one freshly-jitted executable: the first
+    invocation is trace + compile + dispatch (jax compiles synchronously;
+    execution is async), so its wall ms IS the number an operator needs —
+    how long minting this key stalled serving. After that call the watch
+    swaps the raw jitted callable back into ``engine._steps[key]``, so
+    the steady-state hot path pays nothing; a caller holding a stale
+    reference to the watch itself pays one attribute check."""
+
+    __slots__ = ("_fn", "_key", "_engine", "_done")
+
+    def __init__(self, engine, key, fn):
+        self._engine = engine
+        self._key = key
+        self._fn = fn
+        self._done = False
+
+    def __call__(self, *args):
+        if self._done:
+            return self._fn(*args)
+        eng = self._engine
+        # sentinel BEFORE the compile: a frozen serving set refuses the
+        # mint outright rather than paying for it first
+        COMPILES.pre_compile(eng, self._key)
+        t0 = time.perf_counter()
+        out = self._fn(*args)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._done = True
+        COMPILES.record(eng, self._key, ms)
+        steps = getattr(eng, "_steps", None)
+        if steps is not None and steps.get(self._key) is self:
+            steps[self._key] = self._fn  # steady state: zero wrapper cost
+        return out
+
+
+class CompileLedger:
+    """Process-wide record of every executable mint (module singleton:
+    ``COMPILES``). Compiles are rare by the fixed-compilation-key
+    discipline the whole engine keeps, so an always-on ledger costs
+    nothing on the hot path — only the mint moment is instrumented.
+    The warm flag lives on the ENGINE (``Engine._compile_warm``), not
+    here: a supervisor rebuild mints a fresh engine whose own warmup
+    legitimately recompiles the serving set, and a global flag would
+    misread those as post-warmup compiles."""
+
+    MAX_KEYS = 256  # label-cardinality bound on the by_key map
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.freeze = False        # --freeze-compiles
+        self.total = 0
+        self.total_ms = 0.0
+        self.after_warmup = 0      # compiles on an already-warm engine
+        self.key_overflow = 0
+        self.by_key: dict[str, dict] = {}
+
+    def watch(self, engine, key, fn):
+        """Wrap one freshly-jitted callable (the ``Engine._mint`` hook)."""
+        return _CompileWatch(engine, key, fn)
+
+    def pre_compile(self, engine, key) -> None:
+        """The recompile sentinel, fired before a compile on a WARM
+        engine: trace event + counter always; a structured error under
+        ``--freeze-compiles`` (the runtime twin of dlgrind's static
+        fingerprint gate — the offending caller fails, the compile never
+        runs, the serving executables stay exactly the warmed set)."""
+        if not getattr(engine, "_compile_warm", False):
+            return
+        ks = compile_key_str(key)
+        with self._lock:
+            self.after_warmup += 1
+        if TRACER.enabled:
+            TRACER.event("compile_after_warmup", 0, key=ks,
+                         frozen=self.freeze)
+        if self.freeze:
+            from .scheduler import RequestError
+
+            raise RequestError(
+                "compile_after_warmup",
+                f"new compile key {ks!r} after warmup with "
+                "--freeze-compiles (the serving set is frozen; see "
+                "docs/operations.md 'Recompile storms')",
+                retryable=False)
+
+    def record(self, engine, key, ms: float) -> None:
+        ks = compile_key_str(key)
+        warm = bool(getattr(engine, "_compile_warm", False))
+        with self._lock:
+            self.total += 1
+            self.total_ms += ms
+            rec = self.by_key.get(ks)
+            if rec is None:
+                if len(self.by_key) >= self.MAX_KEYS:
+                    self.key_overflow += 1
+                else:
+                    rec = self.by_key[ks] = {"count": 0, "ms": 0.0}
+            if rec is not None:
+                rec["count"] += 1
+                rec["ms"] = round(rec["ms"] + ms, 3)
+                rec["last_ms"] = round(ms, 3)
+        if TRACER.enabled:
+            TRACER.event("compile", 0, key=ks, ms=round(ms, 3), warm=warm)
+
+    def summary(self) -> dict:
+        """The ``compiles`` /stats block (and the /metrics source)."""
+        with self._lock:
+            return {"total": self.total,
+                    "total_ms": round(self.total_ms, 3),
+                    "after_warmup": self.after_warmup,
+                    "frozen": self.freeze,
+                    "key_overflow": self.key_overflow,
+                    "by_key": {k: dict(v) for k, v in self.by_key.items()}}
+
+    def reset(self) -> None:
+        """Test/bench isolation; the singleton survives."""
+        with self._lock:
+            self.freeze = False
+            self.total = 0
+            self.total_ms = 0.0
+            self.after_warmup = 0
+            self.key_overflow = 0
+            self.by_key = {}
+
+
+COMPILES = CompileLedger()
+
+
+# -- HBM ledger -------------------------------------------------------------
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def device_memory_stats():
+    """{bytes_in_use, bytes_limit} from the first local device, or None
+    where the backend has no allocator stats (CPU test runs)."""
+    import jax
+
+    try:
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        return None
+    if not ms or "bytes_in_use" not in ms:
+        return None
+    return {"bytes_in_use": int(ms["bytes_in_use"]),
+            "bytes_limit": int(ms.get("bytes_limit", 0)) or None}
+
+
+def hbm_ledger(engine, prefix_cache=None, *, block_len: int | None = None,
+               device_stats: dict | None | bool = True) -> dict:
+    """Per-category live-bytes for one engine — the ``hbm`` block of
+    /stats and every BENCH row.
+
+    Categories, all derived from KNOWN allocated shapes (exact for
+    weights / KV slots / arena — they are real array ``nbytes``;
+    logits+workspace is the modeled transient: the (B, vocab) f32 logits
+    fetch plus one (B, chunk, dim) activation segment):
+
+      * ``weights_bytes``      — every param leaf (quantized tensors
+        count their packed bytes). Cached on the engine: weights never
+        change size. NOTE: thread-tier replicas SHARE weight buffers, so
+        summing this across replica blocks multi-counts one allocation —
+        the per-replica truth is kv+arena, the weights are per-process.
+      * ``kv_slot_bytes``      — the batched slot cache (all B rows).
+      * ``prefix_arena_bytes`` — the radix cache's K/V block arena.
+      * ``logits_workspace_bytes`` — modeled per-step transient.
+
+    Reconciliation: ``device_bytes_in_use``/``device_bytes_limit`` from
+    ``device.memory_stats()`` where the backend provides it (None on
+    CPU), with ``unaccounted_bytes`` = in_use - accounted when both
+    sides exist (XLA scratch, compiled executables, fusion temps).
+
+    Headroom (what ROADMAP item 1's auto-sizing consumes):
+    ``per_slot_bytes`` (one more batch row's K/V) and
+    ``per_block_bytes`` (one more arena block) are always reported;
+    ``slots_addable``/``prefix_blocks_addable`` = free HBM divided by
+    those, when the backend reports a limit."""
+    spec = engine.spec
+    weights = getattr(engine, "_hbm_weights_bytes", None)
+    if weights is None:
+        weights = _tree_bytes(engine.params)
+        try:
+            engine._hbm_weights_bytes = weights
+        except AttributeError:  # a read-only engine shim: skip the cache
+            pass
+    kv = _tree_bytes(engine.cache)
+    arena = 0
+    n_blocks = 0
+    bl = block_len
+    if prefix_cache is not None:
+        arena = (int(prefix_cache.arena_k.nbytes)
+                 + int(prefix_cache.arena_v.nbytes))
+        n_blocks = prefix_cache.num_blocks
+        bl = prefix_cache.block_len
+    import jax.numpy as jnp
+
+    cache_itemsize = jnp.dtype(engine.cache_dtype).itemsize
+    compute_itemsize = jnp.dtype(engine.compute_dtype).itemsize
+    logits_ws = (engine.batch * spec.vocab_size * 4
+                 + engine.batch * engine.prefill_chunk * spec.dim
+                 * compute_itemsize)
+    per_slot = (kv // engine.batch if engine.batch else 0) or (
+        2 * spec.n_layers * spec.n_kv_heads * engine.seq_len
+        * spec.head_size * cache_itemsize)
+    per_block = (arena // n_blocks) if n_blocks else (
+        2 * spec.n_layers * spec.n_kv_heads * int(bl or 32)
+        * spec.head_size * cache_itemsize)
+    accounted = weights + kv + arena + logits_ws
+    dev = (device_memory_stats() if device_stats is True
+           else (device_stats or None))
+    out = {
+        "weights_bytes": weights,
+        "kv_slot_bytes": kv,
+        "prefix_arena_bytes": arena,
+        "logits_workspace_bytes": logits_ws,
+        "accounted_bytes": accounted,
+        "per_slot_bytes": per_slot,
+        "per_block_bytes": per_block,
+        "device_bytes_in_use": None,
+        "device_bytes_limit": None,
+        "unaccounted_bytes": None,
+        "headroom_bytes": None,
+        "slots_addable": None,
+        "prefix_blocks_addable": None,
+    }
+    if dev is not None:
+        out["device_bytes_in_use"] = dev["bytes_in_use"]
+        out["device_bytes_limit"] = dev["bytes_limit"]
+        out["unaccounted_bytes"] = max(dev["bytes_in_use"] - accounted, 0)
+        if dev["bytes_limit"]:
+            free = max(dev["bytes_limit"] - dev["bytes_in_use"], 0)
+            out["headroom_bytes"] = free
+            out["slots_addable"] = free // per_slot if per_slot else None
+            out["prefix_blocks_addable"] = (free // per_block
+                                            if per_block else None)
+    return out
+
+
+# -- build info -------------------------------------------------------------
+
+
+def mesh_label(mesh) -> str:
+    if mesh is None:
+        return "single"
+    try:
+        return "x".join(f"{k}{v}" for k, v in mesh.shape.items())
+    except Exception:  # noqa: BLE001 — shim engines without a real mesh
+        return "unknown"
+
+
+def build_info(engine=None) -> dict:
+    """The ``dllama_build_info`` label set / ``build`` healthz block:
+    package version, jax version, active backend, mesh shape. Works for
+    every tier including the weightless --replica-hosts front template
+    (engine may be a shape shim or None)."""
+    import jax
+
+    from .. import __version__
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend initialized yet
+        backend = "uninitialized"
+    return {"version": __version__,
+            "jax": jax.__version__,
+            "backend": backend,
+            "mesh": mesh_label(getattr(engine, "mesh", None))}
+
+
+# -- sampled device-time attribution + on-demand capture --------------------
+
+
+class DeviceTimeStats:
+    """Per-entry-point device-ms histograms fed by the sampled step
+    captures: {module name: bounded window of summed device ms within
+    one sampled step}. Module names are the engine's role-specific
+    wrapper names (``jit_slot_decode_step``...) as the XLA trace spells
+    them."""
+
+    def __init__(self, window: int = 512, max_keys: int = 64):
+        from collections import deque  # noqa: F401 — used below
+
+        self.window = int(window)
+        self.max_keys = int(max_keys)
+        self._lock = threading.Lock()
+        self._hist: dict[str, object] = {}
+        self.overflow = 0
+
+    def record(self, name: str, ms: float) -> None:
+        from collections import deque
+
+        with self._lock:
+            d = self._hist.get(name)
+            if d is None:
+                if len(self._hist) >= self.max_keys:
+                    self.overflow += 1
+                    return
+                d = self._hist[name] = deque(maxlen=self.window)
+            d.append(ms)
+
+    def summary(self) -> dict:
+        from .stats import percentile
+
+        with self._lock:
+            items = [(k, list(d)) for k, d in self._hist.items()]
+        out = {}
+        for name, xs in sorted(items, key=lambda kv: -len(kv[1])):
+            out[name] = {"n": len(xs),
+                         "p50_ms": round(percentile(xs, 50), 4),
+                         "mean_ms": round(sum(xs) / len(xs), 4)}
+        return out
+
+
+class Profiler:
+    """On-demand jax.profiler capture + sampled per-step device-time
+    attribution (module singleton: ``PROFILER``).
+
+    Disabled (``sample_every == 0``, the default) the hot path pays ONE
+    attribute read per scheduler iteration — call sites guard with
+    ``if PROFILER.sample_every:`` before calling ``step_begin`` (the
+    tracer's guard-before-kwargs discipline; asserted allocation-free in
+    tests/test_profiler.py). Enabled, every Nth working step runs under
+    a short trace whose per-module device ms feed ``device_time``; the
+    N-1 unsampled steps pay one counter increment.
+
+    ``jax.profiler`` is process-global, so exactly one trace may run at
+    a time: ``capture()`` (the /admin/profile body) and a due step
+    sample contend on one flag — the loser skips, never blocks."""
+
+    def __init__(self):
+        self.sample_every = 0       # 0 = attribution off
+        self._n = 0                 # working-step counter (sampling phase)
+        self.sampled = 0            # sampled steps that produced a trace
+        self.sample_failures = 0    # start/stop/parse errors (backend-dep)
+        self.captures = 0           # /admin/profile captures completed
+        self.device_time = DeviceTimeStats()
+        self._lock = threading.Lock()
+        self._busy = False          # the one process-global trace slot
+
+    # -- the /admin/profile body ----------------------------------------
+
+    def capture(self, directory: str, ms: float) -> dict:
+        """Write one jax.profiler trace of the next `ms` milliseconds to
+        `directory` (created). Synchronous — the caller's thread sleeps
+        out the window (the threaded HTTP server keeps serving), so a
+        200 means the trace is on disk. Returns {"dir", "ms"}; raises
+        RuntimeError("capture busy") when a trace is already running."""
+        import os
+
+        import jax
+
+        with self._lock:
+            if self._busy:
+                raise RuntimeError("capture busy: a profiler trace is "
+                                   "already running in this process")
+            self._busy = True
+        try:
+            os.makedirs(directory, exist_ok=True)
+            jax.profiler.start_trace(directory)
+            try:
+                time.sleep(max(float(ms), 0.0) / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+            self.captures += 1
+            if TRACER.enabled:
+                TRACER.event("profile", 0, dir=directory, ms=float(ms))
+            return {"dir": directory, "ms": float(ms)}
+        finally:
+            with self._lock:
+                self._busy = False
+
+    # -- sampled step attribution ----------------------------------------
+
+    def step_begin(self) -> str | None:
+        """Called at the top of a WORKING scheduler step (never idle
+        iterations) when sampling is on. Returns the capture dir when
+        THIS step is the sampled one, else None."""
+        self._n += 1
+        if self._n % self.sample_every:
+            return None
+        with self._lock:
+            if self._busy:
+                return None  # an /admin/profile capture owns the slot
+            self._busy = True
+        import tempfile
+
+        import jax
+
+        try:
+            d = tempfile.mkdtemp(prefix="dlprof-step-")
+            jax.profiler.start_trace(d)
+            return d
+        except Exception:  # noqa: BLE001 — backend without profiling
+            self.sample_failures += 1
+            with self._lock:
+                self._busy = False
+            return None
+
+    def step_end(self, directory: str) -> None:
+        """Stop the step trace, then hand parse + cleanup to a short
+        daemon thread: per_module_ms walks an xplane protobuf (tens of
+        ms to seconds on a big trace), and the scheduler thread calling
+        this must get back to serving — the sampled step's serving-side
+        cost is the capture itself, never the analysis. Parse errors
+        count, never raise — attribution is best-effort observability,
+        the step itself already succeeded."""
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            self.sample_failures += 1
+            with self._lock:
+                self._busy = False
+            return
+        with self._lock:
+            self._busy = False
+        threading.Thread(target=self._ingest, args=(directory,),
+                         name="dlprof-ingest", daemon=True).start()
+
+    def _ingest(self, directory: str) -> None:
+        import shutil
+
+        try:
+            from .netstats import per_module_ms
+
+            for name, ms in per_module_ms(directory).items():
+                self.device_time.record(name, ms)
+            self.sampled += 1
+        except Exception:  # noqa: BLE001 — malformed/absent trace plane
+            self.sample_failures += 1
+        finally:
+            shutil.rmtree(directory, ignore_errors=True)
+
+    def summary(self) -> dict:
+        """The ``device_time`` /stats block (present when sampling on)."""
+        return {"sample_every": self.sample_every,
+                "sampled_steps": self.sampled,
+                "sample_failures": self.sample_failures,
+                "captures": self.captures,
+                "by_entry": self.device_time.summary()}
+
+    def reset(self) -> None:
+        self.sample_every = 0
+        self._n = 0
+        self.sampled = 0
+        self.sample_failures = 0
+        self.captures = 0
+        self.device_time = DeviceTimeStats()
+
+
+PROFILER = Profiler()

@@ -74,8 +74,9 @@ from .trace import TRACER
 # across the process boundary, runtime/trace.py) and workers ship their
 # span events back in RMSG_TRACE frames — the version handshake turns a
 # mixed-version parent/worker pair into a clean HELLO failure instead of
-# a misparsed frame
-REPLICA_PROTOCOL_VERSION = 2
+# a misparsed frame. v3: RMSG_PROFILE (on-demand jax.profiler capture,
+# runtime/profiler.py) joined the control verbs.
+REPLICA_PROTOCOL_VERSION = 3
 
 # message kinds — a namespace distinct from the cluster control plane's
 # MSG_* so a replica socket accidentally pointed at a cluster control
@@ -102,6 +103,11 @@ RMSG_TRACE = 118        # worker -> client: JSON span events for this
 #                         request's trace id, sent just before the
 #                         terminal frame (the parent tracer merges them
 #                         onto its own timeline — runtime/trace.py)
+RMSG_PROFILE = 119      # client -> worker (control): [ms] — write one
+#                         jax.profiler trace of the next ms milliseconds
+#                         into THIS worker's capture dir; RMSG_OK carries
+#                         {dir} back (the /admin/profile relay,
+#                         runtime/profiler.py)
 
 # [max_tokens, temp_bits, topp_bits, rng_lo, rng_hi, vocab, deadline_ms,
 #  n_eos, trace_id] then n_eos stop ids then the prompt
@@ -153,12 +159,14 @@ class ReplicaServer:
     def __init__(self, sup_factory, *, host: str = "127.0.0.1",
                  port: int = 0, io_timeout: float = 30.0,
                  keepalive: float = 2.0, idle_timeout: float = 600.0,
-                 fault_key: str | None = None):
+                 fault_key: str | None = None,
+                 profile_dir: str | None = None):
         self._factory = sup_factory
         self._io = float(io_timeout)
         self._keepalive = float(keepalive)
         self._idle = float(idle_timeout)
         self._fault_key = fault_key
+        self._profile_dir = profile_dir  # RMSG_PROFILE capture home
         self._sup_lock = threading.RLock()
         self.sup = sup_factory()
         # rebuild carry: RMSG_REBUILD swaps the supervisor wholesale, so
@@ -401,6 +409,15 @@ class ReplicaServer:
             elif kind == RMSG_REBUILD:
                 self._rebuild()
                 self._ok(conn)
+            elif kind == RMSG_PROFILE:
+                # on-demand capture relay (POST /admin/profile on the
+                # parent): synchronous — the OK frame means the trace is
+                # on disk in THIS worker's capture dir. The client sizes
+                # its recv deadline to ms + slack.
+                ms = float(frame[1][0]) if frame[1] else 100.0
+                _send_frame(conn, RMSG_OK, [],
+                            json.dumps(self._profile(ms)).encode(),
+                            timeout=self._io)
             elif kind == RMSG_SHUTDOWN:
                 self._ok(conn)
                 self.shutdown()
@@ -412,6 +429,22 @@ class ReplicaServer:
     def _ok(self, conn: socket.socket) -> None:
         _send_frame(conn, RMSG_OK, [], json.dumps({"ok": True}).encode(),
                     timeout=self._io)
+
+    def _profile(self, ms: float) -> dict:
+        """One jax.profiler capture into this worker's own directory
+        (two processes must never share one trace dir, same rule as the
+        trace sink's per-worker subdirs)."""
+        import tempfile
+
+        from .profiler import PROFILER
+
+        base = self._profile_dir or tempfile.mkdtemp(
+            prefix=f"dlprof-worker-{os.getpid()}-")
+        d = os.path.join(base, f"profile-{int(time.time() * 1e3):x}")
+        try:
+            return {"ok": True, **PROFILER.capture(d, ms)}
+        except RuntimeError as e:  # capture busy
+            return {"ok": False, "error": str(e)}
 
     def _health(self) -> dict:
         """The PONG payload: routability signals + counter snapshot. The
@@ -573,6 +606,12 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
             "request_deadline": getattr(args, "request_deadline", 0.0),
             "stall_timeout": getattr(args, "stall_timeout", 0.0),
         },
+        # device-tier observability: the recompile sentinel freezes and
+        # the attribution sampler sample INSIDE each worker; /admin/
+        # profile captures land under per-worker subdirs of profile_dir
+        "freeze_compiles": bool(getattr(args, "freeze_compiles", False)),
+        "profile_sample": int(getattr(args, "profile_sample", 0) or 0),
+        "profile_dir": getattr(args, "profile_dir", None),
         # flight recorder: workers trace whenever the parent does, so
         # span events exist on both sides of the process boundary
         **({"trace": {
@@ -623,11 +662,26 @@ def main(argv: list[str] | None = None) -> int:
                          decode_every=int(tr.get("decode_every", 8)),
                          sink_dir=sink)
 
+    # device-tier observability (runtime/profiler.py): the worker runs
+    # its own compile ledger / recompile sentinel and sampled device-time
+    # attribution — their blocks ride the stats reply like every other
+    # per-replica block
+    from .profiler import COMPILES, PROFILER
+
+    if cfg.get("freeze_compiles"):
+        COMPILES.freeze = True
+    PROFILER.sample_every = int(cfg.get("profile_sample", 0) or 0)
+    profile_dir = cfg.get("profile_dir")
+    if profile_dir:
+        profile_dir = os.path.join(
+            profile_dir, f"worker-{cfg.get('fault_key') or os.getpid()}")
+
     sup_factory = build_supervisor_factory(cfg)
     server = ReplicaServer(sup_factory, host=args.host, port=args.port,
                            io_timeout=args.io_timeout,
                            keepalive=args.keepalive,
-                           fault_key=cfg.get("fault_key"))
+                           fault_key=cfg.get("fault_key"),
+                           profile_dir=profile_dir)
     port = server.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
@@ -970,6 +1024,23 @@ class WorkerClient:
             return self._request(RMSG_RESET, timeout=timeout)[0] == RMSG_OK
         except (OSError, ClusterProtocolError):
             return False
+
+    def profile(self, ms: float, timeout: float | None = None
+                ) -> dict | None:
+        """RMSG_PROFILE: capture `ms` milliseconds of jax.profiler trace
+        in the worker, into ITS capture dir. Synchronous — the deadline
+        covers the capture window plus slack; None when the worker is
+        unreachable or the verb failed."""
+        try:
+            frame = self._request(RMSG_PROFILE, [int(ms)],
+                                  timeout=(timeout
+                                           or float(ms) / 1e3 + 30.0))
+            if frame[0] != RMSG_OK:
+                return None
+            out = json.loads(frame[2] or b"{}")
+            return out if out.get("ok") else None
+        except (OSError, ClusterProtocolError):
+            return None
 
     def rebuild(self, timeout: float = 120.0) -> bool:
         """RMSG_REBUILD blocks until the worker's fresh supervisor is

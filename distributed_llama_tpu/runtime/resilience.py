@@ -308,6 +308,21 @@ class EngineSupervisor:
                       + sum(getattr(d, k, 0) for d in dead))
         out["state"] = state
         out["resilience"] = self.sup_stats.summary()
+        # device-tier blocks (runtime/profiler.py): live-bytes by
+        # category for the CURRENT generation's engine + arena, the
+        # process compile ledger, and — when --profile-sample is on —
+        # the sampled per-entry-point device-time attribution. Cheap per
+        # scrape: weights bytes are cached on the engine, the rest are
+        # a handful of nbytes reads and dict copies.
+        from .profiler import COMPILES, PROFILER, hbm_ledger
+
+        try:
+            out["hbm"] = hbm_ledger(sched.engine, sched.prefix_cache)
+        except Exception:  # noqa: BLE001 — a mid-rebuild engine swap
+            pass           # must never fail a stats scrape
+        out["compiles"] = COMPILES.summary()
+        if PROFILER.sample_every:
+            out["device_time"] = PROFILER.summary()
         return out
 
     def _retry_after(self) -> float:

@@ -415,6 +415,14 @@ class RemoteReplicaHandle:
                 self._health = {**self._health, "state": "resetting"}
         self.client.reset_breaker()
 
+    def profile(self, ms: float) -> dict | None:
+        """RMSG_PROFILE relay: capture in the WORKER process (its own
+        jax runtime owns the device work), into its per-worker capture
+        dir. None when the worker is unreachable/busy."""
+        if self._closed:
+            return None
+        return self.client.profile(ms)
+
     # -- handle surface ----------------------------------------------------
 
     @property
@@ -991,6 +999,15 @@ class Router:
             "router": self.stats.summary(),
             "replicas": reps,
         })
+        # the PARENT process's compile ledger (worker processes carry
+        # their own in their per-replica summaries). No top-level hbm
+        # block: thread replicas SHARE weight buffers — the per-replica
+        # hbm blocks are each exact for their engine, and summing them
+        # would multi-count the one weight allocation (docs/
+        # observability.md "Device tier").
+        from .profiler import COMPILES
+
+        out["compiles"] = COMPILES.summary()
         return out
 
     def _retry_after(self) -> float:
@@ -998,6 +1015,29 @@ class Router:
         replica's own hint says to come back."""
         return min((h.sup._retry_after() for h in self.replicas),
                    default=1.0)
+
+    def profile(self, ms: float) -> dict | None:
+        """Relay POST /admin/profile into REMOTE replica workers — all
+        captures run CONCURRENTLY so every worker traces the same ms
+        window. Returns {"rK": {dir, ms} | None} per remote replica, or
+        None when this router has no remote replicas (thread replicas
+        share the parent's jax runtime — the HTTP handler captures
+        locally instead)."""
+        remote = [h for h in self.replicas if hasattr(h, "client")]
+        if not remote:
+            return None
+        out: dict = {}
+
+        def run(h):
+            out[f"r{h.id}"] = h.profile(ms)
+
+        threads = [threading.Thread(target=run, args=(h,), daemon=True)
+                   for h in remote]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=float(ms) / 1e3 + 60.0)
+        return out
 
     # -- rolling restart ---------------------------------------------------
 

@@ -65,6 +65,7 @@ from typing import Iterator
 import numpy as np
 
 from .faults import FAULTS
+from .profiler import PROFILER
 from .stats import RequestStats, ServeStats
 from .trace import TRACER
 
@@ -327,11 +328,27 @@ class Scheduler:
                                             for s in self.slots)
 
     def _step_locked(self) -> bool:
+        # sampled device-time attribution (runtime/profiler.py): every
+        # --profile-sample-th WORKING step runs under a short
+        # jax.profiler trace. Bracketed OUTSIDE the _step_t0 window:
+        # start_trace/stop_trace overhead (seconds on a cold profiler)
+        # must never read as step time, or the watchdog declares the
+        # sampled step a stall and the supervisor kills a healthy
+        # generation (observed live: first sample -> watchdog trip ->
+        # spurious recovery). Guard-before-call like the tracer:
+        # sampling off is one attribute read, no allocation; idle
+        # iterations never consume a sample.
+        prof = None
+        if PROFILER.sample_every and (
+                self._queue or any(s.req is not None for s in self.slots)):
+            prof = PROFILER.step_begin()
         self._step_t0 = time.perf_counter()  # watchdog heartbeat: in-step
         try:
             return self._step_body()
         finally:
             self._step_t0 = None
+            if prof is not None:
+                PROFILER.step_end(prof)
 
     def _step_body(self) -> bool:
         if not self._queue and all(s.req is None for s in self.slots):
@@ -620,6 +637,14 @@ class Scheduler:
                 assert all(s.req is None for s in self.slots), (
                     "prefix-cache warmup requires an idle scheduler")
                 self.prefix_cache.warmup()
+            # the serving set is compiled: arm the recompile sentinel —
+            # from here any NEW compile key on this engine is a
+            # compile_after_warmup event (and a structured refusal under
+            # --freeze-compiles; runtime/profiler.py). Engine-only:
+            # duck-typed test engines without the ledger pass through.
+            mark = getattr(eng, "mark_compile_warm", None)
+            if mark is not None:
+                mark()
 
     # -- background thread -------------------------------------------------
 

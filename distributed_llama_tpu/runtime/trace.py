@@ -85,6 +85,10 @@ EVENT_KINDS = (
     "worker_exit",    # replica worker process died (replica, cls, rc)
     "respawn",        # worker respawned to routable (replica, ms)
     "step",           # scheduler iteration (timeline record)
+    "compile",        # an executable was minted (key, ms, warm) —
+    #                   runtime/profiler.CompileLedger
+    "compile_after_warmup",  # the recompile sentinel fired (key, frozen)
+    "profile",        # an /admin/profile capture completed (dir, ms)
 )
 
 
@@ -512,9 +516,63 @@ def _add_block(p: _Prom, block: dict | None, table, *, type_: str,
         p.add(name, block.get(key), labels=labels, type_=type_)
 
 
+def _add_device_blocks(p: _Prom, summary: dict,
+                       labels: dict | None = None) -> None:
+    """The device-tier families (runtime/profiler.py): compile ledger,
+    HBM ledger, sampled device-time attribution — rendered from the
+    same /stats blocks every tier already carries, top-level AND
+    per-replica (labelled)."""
+    pre = "dllama_replica_" if labels else "dllama_"
+    comp = summary.get("compiles")
+    if comp:
+        p.add(pre + "compiles_after_warmup_total",
+              comp.get("after_warmup"), labels, type_="counter",
+              help_="Compiles minted after the serving set was warm "
+                    "(the recompile sentinel)")
+        for key, rec in (comp.get("by_key") or {}).items():
+            lab = {**(labels or {}), "key": _esc(key)}
+            p.add(pre + "compiles_total", rec.get("count"), lab,
+                  type_="counter", help_="Executable mints by compile key")
+            p.add(pre + "compile_ms", rec.get("ms"), lab,
+                  type_="counter",
+                  help_="Cumulative trace+compile wall ms by compile key")
+    hbm = summary.get("hbm")
+    if hbm:
+        for cat, field in (("weights", "weights_bytes"),
+                           ("kv_slots", "kv_slot_bytes"),
+                           ("prefix_arena", "prefix_arena_bytes"),
+                           ("logits_workspace", "logits_workspace_bytes")):
+            p.add(pre + "hbm_bytes", hbm.get(field),
+                  {**(labels or {}), "category": cat},
+                  help_="Live HBM bytes by category (known array shapes)")
+        p.add(pre + "hbm_device_bytes", hbm.get("device_bytes_in_use"),
+              {**(labels or {}), "kind": "in_use"},
+              help_="Backend allocator stats, where provided")
+        p.add(pre + "hbm_device_bytes", hbm.get("device_bytes_limit"),
+              {**(labels or {}), "kind": "limit"})
+        p.add(pre + "hbm_slots_addable", hbm.get("slots_addable"), labels,
+              help_="KV slots that still fit free HBM (headroom)")
+        p.add(pre + "hbm_prefix_blocks_addable",
+              hbm.get("prefix_blocks_addable"), labels,
+              help_="Prefix-arena blocks that still fit free HBM")
+    dev = summary.get("device_time")
+    if dev:
+        p.add(pre + "profile_sampled_steps_total",
+              dev.get("sampled_steps"), labels, type_="counter",
+              help_="Scheduler steps captured for device-time attribution")
+        for entry, rec in (dev.get("by_entry") or {}).items():
+            lab = {**(labels or {}), "entry": _esc(entry)}
+            p.add(pre + "device_ms", rec.get("p50_ms"),
+                  {**lab, "quantile": "0.5"},
+                  help_="Sampled per-step device ms by entry point")
+            p.add(pre + "device_samples_total", rec.get("n"), lab,
+                  type_="counter")
+
+
 def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                       model: str = "dllama", mode: str = "scheduler",
-                      state: str | None = None) -> str:
+                      state: str | None = None,
+                      build: dict | None = None) -> str:
     """The GET /metrics body: the /stats summary dict (supervisor- or
     router-shaped; None while the front door is unbuilt or in legacy
     mode) + the tracer's step-timeline histograms, as Prometheus text
@@ -524,6 +582,12 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
     p = _Prom()
     p.add("dllama_up", 1, {"model": model, "mode": mode},
           help_="The serving process is up", type_="gauge")
+    if build:
+        # the build-info idiom: constant 1, identity in the labels —
+        # join on it to annotate every other series with version/backend
+        p.add("dllama_build_info", 1,
+              {k: _esc(v) for k, v in build.items()},
+              help_="Build identity (constant 1; info in the labels)")
     states = ("ready", "recovering", "broken", "draining", "closed",
               "degraded", "off", "idle")
     st = state or (summary or {}).get("state")
@@ -550,6 +614,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
         p.add("dllama_supervisor_recovery_ms", res.get("recovery_p99_ms"),
               {"quantile": "0.99"})
         _add_block(p, summary.get("router"), _ROUTER, type_="counter")
+        _add_device_blocks(p, summary)
         for rep in summary.get("replicas") or ():
             lab = {"replica": str(rep.get("replica"))}
             p.add("dllama_replica_up",
@@ -567,6 +632,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
             _add_block(p, rep.get("prefix_cache"), tuple(
                 (k, n.replace("dllama_", "dllama_replica_"))
                 for k, n in _PREFIX_GAUGES), type_="gauge", labels=lab)
+            _add_device_blocks(p, rep, labels=lab)
             proc = rep.get("proc")
             if proc:
                 p.add("dllama_replica_proc_exits_total", proc.get("exits"),

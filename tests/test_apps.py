@@ -1397,3 +1397,97 @@ def test_replica_flags_rejected_without_serve_batch():
         dllama.main(["api", "--model", "m", "--tokenizer", "t",
                      "--serve-batch", "2", "--replicas", "0"])
     assert ">= 1" in str(ei.value)
+
+
+def test_api_healthz_build_block_all_modes(api_server, sched_api_server,
+                                           router_api_server):
+    """ISSUE 10 satellite: /healthz carries the build-identity block —
+    {version, jax, backend, mesh} — in every tier (never gated on a
+    launch flag, the same rule as /metrics): version skew across a
+    replica fleet must show on the probe everyone already scrapes."""
+    import jax
+
+    import distributed_llama_tpu as pkg
+
+    targets = [api_server, sched_api_server[0], router_api_server[0]]
+    for host, port in targets:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200, (host, port)
+        b = body["build"]
+        assert b["version"] == pkg.__version__
+        assert b["jax"] == jax.__version__
+        assert b["backend"] == "cpu" and b["mesh"] == "single"
+
+
+def test_api_metrics_build_info_series(sched_api_server):
+    """dllama_build_info rides /metrics as the constant-1 info idiom."""
+    (host, port), _state = sched_api_server
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    assert resp.status == 200
+    line = next(ln for ln in body.splitlines()
+                if ln.startswith("dllama_build_info{"))
+    assert 'backend="cpu"' in line and 'mesh="single"' in line
+    assert line.endswith(" 1")
+
+
+def test_api_admin_profile_captures_and_validates(sched_api_server,
+                                                  tmp_path, monkeypatch):
+    """POST /admin/profile?ms=N: loopback 200 with the trace dir in the
+    body (the capture ran synchronously), garbage ms a clean 400 —
+    and off-loopback it is guarded exactly like every /admin/* verb."""
+    import distributed_llama_tpu.apps.api_server as api_mod
+
+    (host, port), state = sched_api_server
+    monkeypatch.setattr(state, "profile_dir", str(tmp_path / "prof"))
+
+    def post(path, headers=None):
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        conn.request("POST", path, json.dumps({}),
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    status, body = post("/admin/profile?ms=20")
+    assert status == 200, body
+    assert body["status"] == "ok" and body["ms"] == 20.0
+    assert body["dir"].startswith(str(tmp_path / "prof"))
+    import os
+    assert os.path.isdir(body["dir"])
+
+    for bad in ("ms=zz", "ms=-5", "ms=0", "ms=900000"):
+        status, body = post(f"/admin/profile?{bad}")
+        assert status == 400, (bad, body)
+
+    # off-loopback: same guard as every admin verb (the chaos job pins
+    # the process-tier variant in tests/test_replica_procs.py)
+    monkeypatch.setattr(api_mod, "_is_loopback", lambda addr: False)
+    status, body = post("/admin/profile?ms=10")
+    assert status == 403 and "admin" in body["error"]
+    monkeypatch.setattr(state, "admin_token", "tok-9")
+    status, _ = post("/admin/profile?ms=10",
+                     {"Authorization": "Bearer tok-9"})
+    assert status == 200
+
+
+def test_profiler_flags_rejected_without_serve_batch():
+    """--freeze-compiles/--profile-sample hang off the slot scheduler
+    (warmup arms the sentinel; the sampler hooks steps) — dead flags
+    without --serve-batch, same principle as the router/trace knobs."""
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--freeze-compiles"])
+    assert "--serve-batch" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--profile-sample", "8"])
+    assert "--serve-batch" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        dllama.main(["api", "--model", "m", "--tokenizer", "t",
+                     "--serve-batch", "2", "--profile-sample", "0"])
+    assert ">= 1" in str(ei.value)

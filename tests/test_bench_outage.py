@@ -113,7 +113,28 @@ def test_serve_row_emits_valid_json():
     assert s["batch"] == 2 and s["requests"] >= 2
     assert s["ttft_p50_ms"] >= 0 and s["ttft_p99_ms"] >= s["ttft_p50_ms"]
     assert 0 < s["mean_slot_occupancy"] <= 2
+    # ISSUE-10 satellite: every bench row carries the hbm ledger next to
+    # step_timeline — exact allocated bytes, not estimates
+    hbm = s["hbm"]
+    assert hbm["kv_slot_bytes"] > 0 and hbm["weights_bytes"] > 0
+    assert hbm["per_slot_bytes"] * s["batch"] == hbm["kv_slot_bytes"]
+    assert s["step_timeline"], s  # the curve dlprof consumes below
     json.dumps(s)  # the row round-trips as machine-readable JSON
+
+    # ISSUE-10 acceptance: tools/dlprof.py over this REAL BENCH_SERVE=1
+    # artifact reproduces the batch-composition -> ms/step curve from
+    # the step_timeline block and emits a non-null knee + --serve-batch
+    # recommendation
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import dlprof
+
+    report = dlprof.analyze([], [row] + row.get("variants", []))
+    sc = report["step_curve"]
+    assert sc["decode_points"], sc       # the curve reproduced
+    assert sc["knee"] is not None and sc["knee"]["knee_rows"] >= 1
+    rec = sc["recommendation"]
+    assert rec is not None and rec["serve_batch"] >= 1
+    assert report["hbm"] is not None     # the ledger rode the artifact
 
 
 def test_prefix_row_emits_valid_json():
@@ -144,6 +165,7 @@ def test_prefix_row_emits_valid_json():
     assert p["requests"] == 4 and p["hit_rate"] > 0
     assert p["tokens_saved"] >= 48 * 3  # every replayed request seeded
     assert p["ttft_p50_ms_on"] >= 0 and p["ttft_p50_ms_off"] >= 0
+    assert p["hbm"]["prefix_arena_bytes"] > 0  # the REAL arena's bytes
     json.dumps(p)  # the row round-trips as machine-readable JSON
 
 
@@ -189,6 +211,7 @@ def test_router_row_emits_valid_json():
     assert chaos["availability_pct"] is not None
     assert chaos["availability_pct"] >= 99.0, chaos  # readiness held
     assert v["token_parity"] is True
+    assert v["hbm"]["kv_slot_bytes"] > 0  # one replica's exact shape
     json.dumps(v)  # the row round-trips as machine-readable JSON
 
 
@@ -234,6 +257,10 @@ def test_router_procs_row_emits_valid_json():
     assert v["availability_pct"] is not None
     assert v["availability_pct"] >= 99.0, v
     assert v["token_parity"] is True, v
+    # per-WORKER hbm ledgers merged off the stats replies (each process
+    # owns its weights)
+    assert any(k.startswith("r") and v["hbm"][k]["kv_slot_bytes"] > 0
+               for k in v.get("hbm") or {}), v.get("hbm")
     json.dumps(v)  # the row round-trips as machine-readable JSON
 
 

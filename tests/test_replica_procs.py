@@ -81,7 +81,15 @@ WORKER_ENV = {
 }
 
 SPAWN_TIMEOUT = 120.0   # worker startup bound (import + build + warmup)
-RESPAWN_BOUND = 60.0    # death -> routable-again acceptance bound
+# death -> routable-again acceptance HARD CEILING. Deliberately
+# load-tolerant (ISSUE 10 deflake): a respawn is a full interpreter +
+# jax import + engine build + warmup in a fresh subprocess — ~4 s alone
+# with a warm XLA cache, but IN-SUITE the whole pytest run competes for
+# the same cores and the bound was observed flaking at 60 s while the
+# standalone run passed. The assertions below poll (_wait) and only
+# fail at this ceiling; the zero-unstreamed-failures / parity /
+# classification bars stay EXACT — only the timing bound is widened.
+RESPAWN_BOUND = 180.0
 
 
 @pytest.fixture(scope="module")
@@ -303,8 +311,12 @@ def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
         # B (on the surviving replica) never noticed
         assert list(req_b.tokens(timeout=120.0)) == want6
 
-        # supervised respawn: classified, counted, routable within bound
-        assert _wait(lambda: h0.ready, RESPAWN_BOUND), \
+        # supervised respawn: classified, counted, routable within the
+        # (load-tolerant) ceiling — poll-until with a hard bound, both
+        # measured from the kill itself
+        assert _wait(lambda: h0.ready,
+                     max(RESPAWN_BOUND
+                         - (time.perf_counter() - t_kill), 1.0)), \
             f"r0 not routable {RESPAWN_BOUND}s after SIGKILL"
         t_routable = time.perf_counter() - t_kill
         assert t_routable < RESPAWN_BOUND
@@ -520,5 +532,75 @@ def test_shadow_index_routes_cache_aware_and_clears_on_respawn(
         os.kill(h0._proc.proc.pid, signal.SIGKILL)
         assert _wait(lambda: h0.proc_stats.respawns == 1, RESPAWN_BOUND)
         assert h0.match_len(p) == 0         # shadow cleared with the corpse
+    finally:
+        router.close()
+
+
+# -- /admin/profile over the process tier (ISSUE 10) ------------------------
+
+
+def test_admin_profile_guarded_and_rmsg_profile_roundtrips(tmp_path,
+                                                           oracle_bits,
+                                                           monkeypatch):
+    """The chaos-job half of the ISSUE 10 capture satellite: the
+    RMSG_PROFILE verb round-trips to a REAL worker process — the capture
+    lands in that worker's own per-worker dir — and, over HTTP on the
+    process tier, POST /admin/profile is admin-guarded off-loopback
+    exactly like every other /admin/* verb (403 bare, 200 + per-worker
+    dirs with the --admin-token bearer)."""
+    import http.client
+    import json as _json
+    from http.server import ThreadingHTTPServer
+
+    import distributed_llama_tpu.apps.api_server as api_mod
+    from distributed_llama_tpu.apps.api_server import (ApiState,
+                                                       make_handler)
+
+    cfg = dict(CFG, profile_dir=str(tmp_path / "prof"), fault_key="r0")
+    proc = WorkerProc(0, cfg, workdir=str(tmp_path), env=WORKER_ENV)
+    h0 = RemoteReplicaHandle(0, proc=proc, poll_interval=0.1,
+                             spawn_backoff_base=0.05,
+                             spawn_timeout=SPAWN_TIMEOUT,
+                             respawn_timeout=SPAWN_TIMEOUT)
+    router = Router(None, policy="least_loaded", retry_budget=1,
+                    handle_factories=[lambda: h0])
+    try:
+        # the verb itself, straight through the framed codec: the 200
+        # (RMSG_OK) is synchronous with the capture, so the per-worker
+        # dir exists the moment the reply lands
+        out = h0.profile(40)
+        assert out is not None, "RMSG_PROFILE failed"
+        want_prefix = os.path.join(str(tmp_path), "prof", "worker-r0")
+        assert out["dir"].startswith(want_prefix), out
+        assert os.path.isdir(out["dir"])
+
+        # HTTP relay + the off-loopback guard
+        state = ApiState(None, None, None, model_name="procs",
+                         serve_batch=2, replica_procs=1)
+        state._scheduler = router
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            def post(headers=None):
+                conn = http.client.HTTPConnection(*srv.server_address,
+                                                  timeout=120)
+                conn.request("POST", "/admin/profile?ms=40", b"{}",
+                             {"Content-Type": "application/json",
+                              **(headers or {})})
+                resp = conn.getresponse()
+                return resp.status, _json.loads(resp.read())
+
+            monkeypatch.setattr(api_mod, "_is_loopback", lambda a: False)
+            status, body = post()
+            assert status == 403 and "admin" in body["error"]
+            state.admin_token = "tok-prof"
+            status, body = post({"Authorization": "Bearer tok-prof"})
+            assert status == 200, body
+            w = body["workers"]["r0"]
+            assert w is not None and w["dir"].startswith(want_prefix)
+            assert os.path.isdir(w["dir"])
+            assert w["dir"] != out["dir"]  # a fresh capture, not a replay
+        finally:
+            srv.shutdown()
     finally:
         router.close()

@@ -610,3 +610,60 @@ def test_admin_trace_404_when_tracing_off(api_state):
         srv.shutdown()
         if state._scheduler is not None:
             state._scheduler.close()
+
+
+def test_admin_trace_kind_and_since_filters(api_state, tiny):
+    """ISSUE 10 satellite: GET /admin/trace grows kind= and since_ms=
+    filters alongside n=/id= — validated (400 on garbage), and the kind
+    filter scans the WHOLE ring before tailing n (a sparse kind must not
+    vanish behind n pre-filter events)."""
+    TRACER.configure(capacity=2048)
+    state = api_state(serve_batch=2, serve_chunk=16)
+    srv = _serve(state)
+    try:
+        conn = http.client.HTTPConnection(*srv.server_address, timeout=240)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "ab", "max_tokens": 4,
+                                 "temperature": 0}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+
+        # kind=: only that kind comes back — here 'finish', which sits
+        # behind many decode/step events (n=3 unfiltered would miss it)
+        code, _, body = _get(srv.server_address,
+                             "/admin/trace?kind=finish&n=3")
+        assert code == 200
+        evs = [json.loads(ln) for ln in body.splitlines()][1:]
+        assert evs and all(e["kind"] == "finish" for e in evs)
+
+        # since_ms=: a large window keeps everything, a zero window
+        # keeps (effectively) nothing
+        code, _, body = _get(srv.server_address,
+                             "/admin/trace?since_ms=600000")
+        assert code == 200
+        recent = [json.loads(ln) for ln in body.splitlines()][1:]
+        assert recent
+        code, _, body = _get(srv.server_address, "/admin/trace?since_ms=0")
+        assert code == 200
+        assert len([json.loads(ln) for ln in body.splitlines()][1:]) <= 1
+
+        # filters compose with id=
+        tid = next(e["tid"] for e in recent if e["kind"] == "finish")
+        code, _, body = _get(srv.server_address,
+                             f"/admin/trace?id={tid}&kind=prefill")
+        span = [json.loads(ln) for ln in body.splitlines()][1:]
+        assert span and all(e["kind"] == "prefill" and e["tid"] == tid
+                            for e in span)
+
+        # validation: garbage is a 400, never an empty-but-200 dump
+        for q in ("kind=notakind", "kind=", "since_ms=abc",
+                  "since_ms=-1", "since_ms=nan"):
+            code, _, _ = _get(srv.server_address, f"/admin/trace?{q}")
+            assert code == 400, q
+    finally:
+        srv.shutdown()
+        if state._scheduler is not None:
+            state._scheduler.close()
