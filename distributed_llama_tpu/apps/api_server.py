@@ -112,7 +112,10 @@ class ApiState:
                  replica_procs: int = 0, replica_hosts=None,
                  worker_config: dict | None = None,
                  admin_token: str | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None,
+                 autosize: dict | None = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -185,6 +188,13 @@ class ApiState:
         # /healthz + /metrics answer carries
         self.profile_dir = profile_dir
         self._build_info: dict | None = None
+        # SLO-aware admission (runtime/scheduler.AdmissionPolicy): either
+        # target arms the adaptive chunk-width policy in every replica's
+        # scheduler; the auto-sizing decision record (resolve_auto_shape)
+        # rides /stats + /metrics so the chosen shape is always visible
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_itl_ms = slo_itl_ms
+        self.autosize = autosize
 
     def build_info(self) -> dict:
         """{version, jax, backend, mesh} — computed once (the backend
@@ -226,7 +236,9 @@ class ApiState:
                     route_policy=self.route_policy,
                     replica_procs=self.replica_procs,
                     replica_hosts=self.replica_hosts,
-                    worker_config=self.worker_config)
+                    worker_config=self.worker_config,
+                    slo_ttft_ms=self.slo_ttft_ms,
+                    slo_itl_ms=self.slo_itl_ms)
             return self._scheduler
 
     def batch_engine(self):
@@ -849,6 +861,11 @@ def make_handler(state: ApiState):
                 from ..runtime.trace import TRACER
                 if TRACER.enabled:
                     payload["trace"] = TRACER.summary()
+                if state.autosize:
+                    # the startup auto-sizing decision (chosen shape +
+                    # every input) — present in EVERY scheduler state,
+                    # idle included: the decision was made at startup
+                    payload["autosize"] = state.autosize
                 self._json(200, payload)
             elif self.path == "/metrics":
                 self._metrics()
@@ -888,6 +905,10 @@ def make_handler(state: ApiState):
             payload = dict(payload or {})
             if cluster is not None:
                 payload["cluster"] = cluster
+            if state.autosize:
+                # dllama_autosize_* gauges from the startup decision —
+                # visible from the FIRST scrape (idle included)
+                payload["autosize"] = state.autosize
             # device-tier blocks for the tiers whose summary has none:
             # the compile ledger is process-global (legacy engines mint
             # through it too — the supervisor summary carries the same
@@ -1456,6 +1477,37 @@ def serve(args) -> None:
             # prefix cache a --session file could describe
             sys.exit("error: --serve-batch (continuous-batching scheduler) "
                      "does not compose with --session prefix persistence")
+    # SLO-aware admission + auto-sizing flags (runtime/scheduler.
+    # AdmissionPolicy / runtime/profiler.resolve_auto_shape): dead-flag
+    # discipline like every knob family above — an SLO nobody enforces
+    # or an artifact nobody reads must be a parse-time error
+    slo_ttft = getattr(args, "slo_ttft_ms", None)
+    slo_itl = getattr(args, "slo_itl_ms", None)
+    if (slo_ttft is not None or slo_itl is not None) and not serve_batch:
+        sys.exit("error: --slo-ttft-ms/--slo-itl-ms require "
+                 "--serve-batch N|auto (the SLO-aware admission policy "
+                 "adapts the scheduler's chunked-prefill width)")
+    for name, v in (("--slo-ttft-ms", slo_ttft), ("--slo-itl-ms", slo_itl)):
+        if v is not None and not v > 0:
+            sys.exit(f"error: {name} must be > 0 "
+                     "(omit the flag to disable)")
+    prefix_blocks = getattr(args, "prefix_blocks", 0)
+    auto_batch = serve_batch == "auto"
+    auto_blocks = prefix_blocks == "auto"
+    autotune_file = getattr(args, "autotune", None)
+    if autotune_file and not (auto_batch or auto_blocks):
+        sys.exit("error: --autotune has no effect without --serve-batch "
+                 "auto or --prefix-blocks auto (tools/dlprof.py consumes "
+                 "the artifact offline)")
+    autotune_art = None
+    if autotune_file:
+        # a bad artifact must be a clear CLI error before any engine
+        # work, never a wrong silent batch size
+        from ..runtime.profiler import load_autotune
+        try:
+            autotune_art = load_autotune(autotune_file)
+        except (OSError, ValueError) as e:
+            sys.exit(f"error: --autotune {autotune_file}: {e}")
     if getattr(args, "prefix_cache", False) and not serve_batch:
         # the radix cache lives on the slot scheduler (the legacy path
         # keeps its own single-session prefix reuse) — loud error beats
@@ -1464,7 +1516,7 @@ def serve(args) -> None:
                  "(the radix cache serves the slot scheduler; the legacy "
                  "path already reuses its single session's prefix)")
     if not getattr(args, "prefix_cache", False) and (
-            getattr(args, "prefix_blocks", 0) > 0
+            auto_blocks or prefix_blocks > 0
             or getattr(args, "prefix_block_len", None) is not None):
         # same principle one flag over: sizing knobs without the cache
         # itself would be silently dead configuration (block-len uses a
@@ -1494,6 +1546,24 @@ def serve(args) -> None:
         sys.exit("error: --replica-procs/--replica-hosts do not compose "
                  "with --nnodes (each worker is its own single-host "
                  "engine; see ROADMAP item 2 for the composition)")
+    if replica_hosts_raw and (slo_ttft is not None or slo_itl is not None):
+        # pre-started workers were launched with their OWN configs; the
+        # parent cannot arm a policy in them (unlike --replica-procs,
+        # whose spawned workers receive the SLOs via the shipped worker
+        # config) — an SLO nobody enforces must be a parse-time error
+        sys.exit("error: --slo-ttft-ms/--slo-itl-ms do not reach "
+                 "--replica-hosts workers (their configs are their "
+                 "operators'): set the SLOs in each worker's own config "
+                 "instead")
+    if (auto_batch or auto_blocks) and process_tier:
+        # resolve_auto_shape needs a LOCAL engine's real array shapes;
+        # the process tier's parent holds only a spec template — refuse
+        # clearly at parse time instead of crashing mid-build
+        sys.exit("error: --serve-batch/--prefix-blocks 'auto' need a "
+                 "ledger-capable local engine; the process tier's "
+                 "workers own their engines — pass explicit sizes "
+                 "(calibrate with tools/autotune.py and use its "
+                 "recommendation)")
     if not serve_batch and (
             replicas > 1 or process_tier
             or getattr(args, "retry_budget", None) is not None
@@ -1587,8 +1657,36 @@ def serve(args) -> None:
         if not 1 <= bl <= engine.seq_len:
             sys.exit(f"error: --prefix-block-len {bl} outside 1.."
                      f"{engine.seq_len} (the engine context)")
-        if getattr(args, "prefix_blocks", 0) < 0:
-            sys.exit("error: --prefix-blocks must be >= 0 (0 = auto)")
+        if not auto_blocks and prefix_blocks < 0:
+            sys.exit("error: --prefix-blocks must be >= 0 "
+                     "(0 = the 2xBxcontext default, or 'auto')")
+    autosize = None
+    if auto_batch or auto_blocks:
+        # resolve the sentinels against the REAL engine's ledger, once,
+        # before any scheduler exists: measured headroom capped by the
+        # calibrated (or default-heuristic) knee. The decision record is
+        # logged here and exported on /stats + /metrics so an operator
+        # can always see what was chosen and why.
+        from ..runtime.profiler import resolve_auto_shape
+        try:
+            autosize = resolve_auto_shape(
+                engine, serve_batch=serve_batch,
+                prefix_blocks=prefix_blocks,
+                prefix_block_len=prefix_block_len, replicas=replicas,
+                autotune=autotune_art, slo_itl_ms=slo_itl)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        serve_batch = autosize["serve_batch"]
+        prefix_blocks = autosize["prefix_blocks"]
+        inp = autosize["inputs"]
+        print(f"⚖️  auto-sized: --serve-batch {serve_batch} "
+              f"({autosize['serve_batch_basis']})"
+              + (f", --prefix-blocks {prefix_blocks} "
+                 f"({autosize['prefix_blocks_basis']})"
+                 if auto_blocks else "")
+              + f" — knee={inp['knee_rows']} [{inp['knee_basis']}], "
+                f"headroom_bytes={inp['headroom_bytes']}, "
+                f"slots_addable={inp['slots_addable']}")
     state = ApiState(engine, tokenizer, sampler,
                      lookup_decode=getattr(args, "lookup_decode", 0),
                      serve_batch=serve_batch,
@@ -1597,8 +1695,10 @@ def serve(args) -> None:
                      request_deadline=getattr(args, "request_deadline", 0.0),
                      stall_timeout=getattr(args, "stall_timeout", 0.0),
                      prefix_cache=getattr(args, "prefix_cache", False),
-                     prefix_blocks=getattr(args, "prefix_blocks", 0),
+                     prefix_blocks=prefix_blocks,
                      prefix_block_len=prefix_block_len,
+                     slo_ttft_ms=slo_ttft, slo_itl_ms=slo_itl,
+                     autosize=autosize,
                      replicas=replicas,
                      retry_budget=(1 if getattr(args, "retry_budget", None)
                                    is None else args.retry_budget),

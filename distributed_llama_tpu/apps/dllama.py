@@ -32,6 +32,21 @@ import jax
 import numpy as np
 
 
+def _int_or_auto(v: str):
+    """argparse type for --serve-batch/--prefix-blocks: a plain int, or
+    the literal 'auto' — resolved at engine build from HBM-ledger
+    headroom capped by the calibrated batch knee (runtime/profiler.
+    resolve_auto_shape; docs/serving.md "Auto-sizing")."""
+    s = v.strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        return int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {v!r}")
+
+
 def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dllama",
@@ -131,7 +146,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "rejection-style, distribution-exact vs the host "
                         "sampler (different RNG stream). Net-new: the "
                         "reference is strictly 1 token/forward")
-    p.add_argument("--serve-batch", type=int, default=0, metavar="B",
+    p.add_argument("--serve-batch", type=_int_or_auto, default=0,
+                   metavar="B|auto",
                    help="api mode: run the continuous-batching scheduler "
                         "with B KV slots (runtime/scheduler.py, docs/"
                         "serving.md) — /v1/completions and /v1/chat/"
@@ -140,9 +156,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "borrows the same engine. Decode is weight-read-"
                         "bound — B live slots amortize one weight read per "
                         "step for near-Bx aggregate tok/s; only the B-row "
-                        "KV cache is new memory. Single-process, single-"
-                        "device engines only. Net-new: the reference "
-                        "serves batch=1")
+                        "KV cache is new memory. 'auto' sizes B at startup "
+                        "from HBM-ledger headroom capped by the batch knee "
+                        "(--autotune artifact, or a conservative default) "
+                        "— the decision is logged and exported on /stats "
+                        "(docs/serving.md 'Auto-sizing'). Single-process, "
+                        "single-device engines only. Net-new: the "
+                        "reference serves batch=1")
     p.add_argument("--serve-chunk", type=int, default=0, metavar="C",
                    help="api mode: prefill chunk width for the continuous-"
                         "batching scheduler (tail chunks pad to C, so C is "
@@ -150,7 +170,37 @@ def build_argparser() -> argparse.ArgumentParser:
                         "engine's prefill chunk, capped to the context). "
                         "Smaller C bounds the inter-token stall admission "
                         "adds to running requests; larger C prefills new "
-                        "prompts in fewer steps (docs/serving.md)")
+                        "prompts in fewer steps (docs/serving.md). With "
+                        "--slo-ttft-ms/--slo-itl-ms this is the WIDEST "
+                        "rung of the adaptive width ladder")
+    # SLO-aware self-tuning admission (api mode, with --serve-batch;
+    # runtime/scheduler.AdmissionPolicy, docs/serving.md "Auto-sizing and
+    # SLO-aware admission"): either flag arms the policy
+    p.add_argument("--slo-ttft-ms", type=float, default=None, metavar="MS",
+                   help="api mode, with --serve-batch: time-to-first-token "
+                        "target. The admission policy widens the chunked-"
+                        "prefill width (toward --serve-chunk) when the "
+                        "live TTFT EWMA endangers this bound and inter-"
+                        "token latency has headroom — new prompts finish "
+                        "prefilling in fewer iterations")
+    p.add_argument("--slo-itl-ms", type=float, default=None, metavar="MS",
+                   help="api mode, with --serve-batch: inter-token-latency "
+                        "target. Every scheduler iteration with prefill "
+                        "work stretches running streams' token gap by one "
+                        "chunk forward; the admission policy shrinks the "
+                        "chunk width one warmed rung at a time when the "
+                        "live step-time EWMA approaches this bound, and "
+                        "widens again when decode rows idle. Host-side "
+                        "only: the width ladder is warmed up front, so "
+                        "--freeze-compiles stays green while it adapts")
+    p.add_argument("--autotune", default=None, metavar="FILE",
+                   help="api mode, with --serve-batch auto or "
+                        "--prefix-blocks auto: AUTOTUNE.json calibration "
+                        "artifact (tools/autotune.py) supplying the "
+                        "measured batch knee that caps the auto-sizing; "
+                        "without it a conservative default knee applies. "
+                        "tools/dlprof.py consumes the same artifact "
+                        "offline to flag knee drift")
     # prefix-cache flags (api mode; runtime/prefix_cache.py,
     # docs/serving.md "Prefix caching")
     p.add_argument("--prefix-cache", action="store_true",
@@ -164,12 +214,16 @@ def build_argparser() -> argparse.ArgumentParser:
                         "GET /stats gains a prefix_cache hit-rate/"
                         "tokens-saved block. Net-new: the reference "
                         "recomputes every prompt from scratch")
-    p.add_argument("--prefix-blocks", type=int, default=0, metavar="N",
-                   help="prefix-cache arena size in blocks (0 = auto: "
-                        "2 x serve-batch x context worth of blocks). "
-                        "Arena bytes = N x 2 x layers x kv_heads x "
-                        "block_len x head_size x cache dtype — budget it "
-                        "against the B-row KV cache (docs/serving.md)")
+    p.add_argument("--prefix-blocks", type=_int_or_auto, default=0,
+                   metavar="N|auto",
+                   help="prefix-cache arena size in blocks (0 = the "
+                        "2 x serve-batch x context default; 'auto' = that "
+                        "target capped by measured HBM headroom — the "
+                        "arena never eats the slots' room; decision on "
+                        "/stats like --serve-batch auto). Arena bytes = "
+                        "N x 2 x layers x kv_heads x block_len x "
+                        "head_size x cache dtype — budget it against the "
+                        "B-row KV cache (docs/serving.md)")
     p.add_argument("--prefix-block-len", type=int, default=None,
                    metavar="L",
                    help="prefix-cache block granularity in tokens "

@@ -578,7 +578,12 @@ def build_supervisor_factory(cfg: dict):
         stall_timeout=serve.get("stall_timeout") or 10.0,
         prefix_blocks=n_blocks,
         prefix_block_len=int(cfg.get("prefix_block_len", 32)),
-        fault_key=cfg.get("fault_key"))
+        fault_key=cfg.get("fault_key"),
+        # SLO-aware admission runs INSIDE each worker (the policy reads
+        # the worker's own step timeline; its block rides the stats
+        # reply like every other per-replica block)
+        slo_ttft_ms=serve.get("slo_ttft_ms"),
+        slo_itl_ms=serve.get("slo_itl_ms"))
 
     return lambda: EngineSupervisor(engine_factory, **sup_kwargs)
 
@@ -605,6 +610,8 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
             "max_queue": getattr(args, "queue_depth", 0),
             "request_deadline": getattr(args, "request_deadline", 0.0),
             "stall_timeout": getattr(args, "stall_timeout", 0.0),
+            "slo_ttft_ms": getattr(args, "slo_ttft_ms", None),
+            "slo_itl_ms": getattr(args, "slo_itl_ms", None),
         },
         # device-tier observability: the recompile sentinel freezes and
         # the attribution sampler sample INSIDE each worker; /admin/

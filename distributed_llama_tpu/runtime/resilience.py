@@ -85,7 +85,9 @@ class EngineSupervisor:
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
                  breaker_threshold: int = 3,
                  prefix_blocks: int = 0, prefix_block_len: int = 32,
-                 fault_key: str | None = None):
+                 fault_key: str | None = None,
+                 slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None):
         self._factory = engine_factory
         self._chunk = chunk
         # replica identity at the key-filtered fault sites (runtime/
@@ -101,6 +103,11 @@ class EngineSupervisor:
         # Scheduler._abort_all invalidate on the dying generation).
         self._prefix_blocks = int(prefix_blocks)
         self._prefix_block_len = int(prefix_block_len)
+        # SLO targets for the adaptive admission policy — every rebuilt
+        # generation's scheduler gets a FRESH policy (its EWMAs describe
+        # the dead engine's steps; the new one re-learns in a few steps)
+        self._slo_ttft_ms = slo_ttft_ms
+        self._slo_itl_ms = slo_itl_ms
         self.max_queue = int(max_queue)
         self._queue_timeout = queue_timeout
         self._request_deadline = request_deadline
@@ -346,7 +353,9 @@ class EngineSupervisor:
                          max_queue=self.max_queue,
                          queue_timeout=self._queue_timeout,
                          request_deadline=self._request_deadline,
-                         prefix_cache=pc, fault_key=self._fault_key)
+                         prefix_cache=pc, fault_key=self._fault_key,
+                         slo_ttft_ms=self._slo_ttft_ms,
+                         slo_itl_ms=self._slo_itl_ms)
 
     def _start_loop(self, sched: Scheduler, gen: int) -> None:
         for g in [g for g, t in self._loop_threads.items()

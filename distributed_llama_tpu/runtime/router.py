@@ -1254,7 +1254,9 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                      worker_config: dict | None = None,
                      workdir: str | None = None,
                      worker_io_timeout: float = 30.0,
-                     spawn_timeout: float = 300.0):
+                     spawn_timeout: float = 300.0,
+                     slo_ttft_ms: float | None = None,
+                     slo_itl_ms: float | None = None):
     """The ONE constructor of the serving front door, shared by every
     deployment shape (the engine-owner logic that used to live in
     apps/api_server.ApiState.scheduler):
@@ -1336,7 +1338,8 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         max_queue=queue_depth or 4 * serve_batch,
         request_deadline=request_deadline or None,
         stall_timeout=stall_timeout or 10.0,
-        prefix_blocks=n_blocks, prefix_block_len=prefix_block_len)
+        prefix_blocks=n_blocks, prefix_block_len=prefix_block_len,
+        slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
     if replicas <= 1:
         return EngineSupervisor(engine_factory, **sup_kwargs)
     return Router(engine_factory, replicas=replicas,

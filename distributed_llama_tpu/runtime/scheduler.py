@@ -178,6 +178,123 @@ class ServeRequest:
                 raise RequestError("error", f"scheduler error: {val}")
 
 
+def chunk_ladder(chunk: int, rungs: int = 4) -> list[int]:
+    """The adaptive admission policy's FIXED chunk-width menu: descending
+    halvings of the configured width, at most `rungs` entries, floor 1.
+    A ladder (not a continuum) keeps the prefill compile-key set bounded
+    and knowable up front — ``Scheduler.warmup()`` compiles every rung,
+    so an adaptive run mints ZERO post-warmup keys and ``--freeze-
+    compiles`` stays green while the width moves."""
+    ladder = [int(chunk)]
+    while len(ladder) < rungs and ladder[-1] > 1:
+        ladder.append(max(ladder[-1] // 2, 1))
+    return ladder
+
+
+class AdmissionPolicy:
+    """SLO-aware self-tuning admission: trade per-iteration chunked-
+    prefill width against decode occupancy (Orca's iteration-level knob)
+    using the LIVE step timeline, entirely host-side.
+
+    A scheduler iteration with both prefill and decode rows costs one
+    (B, C) chunk forward plus one (B, 1) decode forward, and every
+    decoding row's inter-token gap IS that iteration's wall time — so the
+    chunk width C is the admission policy's one real lever: wide chunks
+    finish prompts in few iterations (good TTFT) but stretch every
+    running stream's gap (bad ITL); narrow chunks the reverse. The policy
+    walks a fixed width ladder (``chunk_ladder``) one rung at a time:
+
+      * SHRINK one rung when decoding rows saw prefill interference and
+        the ITL EWMA is approaching ``slo_itl_ms`` (> shrink_frac of it);
+      * WIDEN one rung when decode rows are idle (a pure-prefill
+        iteration stretches nobody's gap), when the ITL EWMA sits
+        comfortably under the SLO (< widen_frac), or when the TTFT EWMA
+        is endangering ``slo_ttft_ms`` while ITL still has headroom.
+
+    ``cooldown`` observed steps of hysteresis separate transitions so one
+    noisy step cannot thrash the width. Pure bookkeeping — no device
+    dispatch, no new jitted programs (the rung widths are all warmed) —
+    so dlgrind fingerprints and the compile sentinel are untouched by
+    construction. Exported as the ``admission`` /stats block and the
+    ``dllama_admission_*`` /metrics family."""
+
+    def __init__(self, chunk: int, *, slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None, rungs: int = 4,
+                 alpha: float = 0.25, shrink_frac: float = 0.85,
+                 widen_frac: float = 0.5, cooldown: int = 2):
+        assert slo_ttft_ms or slo_itl_ms, "an SLO-less policy has no goal"
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_itl_ms = slo_itl_ms
+        self.ladder = chunk_ladder(chunk, rungs)
+        self._rung = 0              # index into ladder; 0 = widest
+        self.alpha = float(alpha)   # EWMA weight of the newest sample
+        self.shrink_frac = float(shrink_frac)
+        self.widen_frac = float(widen_frac)
+        self.cooldown = int(cooldown)
+        self._since_change = self.cooldown  # first decision is eligible
+        self.itl_ewma_ms: float | None = None
+        self.ttft_ewma_ms: float | None = None
+        self.shrinks = 0
+        self.widens = 0
+
+    @property
+    def width(self) -> int:
+        return self.ladder[self._rung]
+
+    def _mix(self, prev: float | None, sample: float) -> float:
+        return sample if prev is None else (
+            self.alpha * sample + (1.0 - self.alpha) * prev)
+
+    def observe_ttft(self, ttft_ms: float) -> None:
+        self.ttft_ewma_ms = self._mix(self.ttft_ewma_ms, float(ttft_ms))
+
+    def observe_step(self, wall_ms: float, decode_rows: int,
+                     prefill_rows: int) -> None:
+        """One WORKING iteration's composition + wall ms (called by
+        ``_step_body`` after the forwards ran). A step with decode rows
+        is their observed inter-token gap — that, not a per-request
+        after-the-fact average, is the signal that can still save the
+        requests currently running."""
+        if decode_rows:
+            self.itl_ewma_ms = self._mix(self.itl_ewma_ms, float(wall_ms))
+        self._since_change += 1
+        if self._since_change < self.cooldown:
+            return
+        itl, slo_i = self.itl_ewma_ms, self.slo_itl_ms
+        ttft, slo_t = self.ttft_ewma_ms, self.slo_ttft_ms
+        if (slo_i and decode_rows and prefill_rows and itl is not None
+                and itl > self.shrink_frac * slo_i):
+            if self._rung + 1 < len(self.ladder):
+                self._rung += 1
+                self.shrinks += 1
+                self._since_change = 0
+            return
+        comfortable = (slo_i is not None and itl is not None
+                       and itl < self.widen_frac * slo_i)
+        ttft_pressure = (slo_t is not None and ttft is not None
+                         and ttft > self.shrink_frac * slo_t
+                         and (slo_i is None or itl is None
+                              or itl < self.shrink_frac * slo_i))
+        if ((decode_rows == 0 or comfortable or ttft_pressure)
+                and self._rung > 0):
+            self._rung -= 1
+            self.widens += 1
+            self._since_change = 0
+
+    def summary(self) -> dict:
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        return {
+            "slo_ttft_ms": self.slo_ttft_ms,
+            "slo_itl_ms": self.slo_itl_ms,
+            "chunk_width": self.width,
+            "chunk_ladder": list(self.ladder),
+            "itl_ewma_ms": rnd(self.itl_ewma_ms),
+            "ttft_ewma_ms": rnd(self.ttft_ewma_ms),
+            "shrinks": self.shrinks,
+            "widens": self.widens,
+        }
+
+
 class _Slot:
     """One row of the batched KV cache. state is derived: FREE when req is
     None, PREFILL while off < len(prompt), DECODE after. `pos` is the next
@@ -201,7 +318,9 @@ class Scheduler:
     def __init__(self, engine, *, chunk: int | None = None,
                  max_queue: int = 0, queue_timeout: float | None = None,
                  request_deadline: float | None = None,
-                 prefix_cache=None, fault_key: str | None = None):
+                 prefix_cache=None, fault_key: str | None = None,
+                 slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None):
         self.engine = engine
         # identifies THIS scheduler at the replica-level fault sites
         # (runtime/faults.py replica_raise/replica_stall): the router
@@ -210,6 +329,14 @@ class Scheduler:
         self.fault_key = fault_key
         self.chunk = int(chunk or min(engine.prefill_chunk, engine.seq_len))
         assert 1 <= self.chunk <= engine.seq_len, self.chunk
+        # SLO-aware self-tuning admission (either SLO flag arms it): the
+        # policy walks the chunk-width ladder per iteration off the live
+        # step timeline; `chunk` stays the WIDEST rung (and the only
+        # width when no SLO is set)
+        self.admission = (AdmissionPolicy(self.chunk,
+                                          slo_ttft_ms=slo_ttft_ms,
+                                          slo_itl_ms=slo_itl_ms)
+                          if (slo_ttft_ms or slo_itl_ms) else None)
         self.slots = [_Slot(i) for i in range(engine.batch)]
         # radix prefix cache (runtime/prefix_cache.PrefixCache) — must be
         # built over THIS engine's arena; a supervisor rebuild passes a
@@ -236,6 +363,7 @@ class Scheduler:
         self.stats = ServeStats()
         if prefix_cache is not None:
             self.stats.prefix = prefix_cache.stats
+        self.stats.admission = self.admission  # None when no SLO is set
         self._thread: threading.Thread | None = None
         self._stop = False
         self._closed = False
@@ -394,8 +522,13 @@ class Scheduler:
         self.stats.steps += 1
         self.stats.occupancy.append(len(pre) + len(dec))
         self.stats.queue_depth.append(len(self._queue))
+        # per-iteration chunk width: the SLO-aware policy's current rung
+        # (a warmed compile key — see AdmissionPolicy/chunk_ladder), or
+        # the one configured width when no SLO is set
+        cw = (self.admission.width if self.admission is not None
+              else self.chunk) if pre else 0
         if pre:
-            self._prefill_chunk(pre)
+            self._prefill_chunk(pre, cw)
         if dec:
             # rows that finished their prompt inside _prefill_chunk above
             # wait for the NEXT iteration: every live row gets at most one
@@ -408,11 +541,17 @@ class Scheduler:
             # from the watchdog heartbeat t0 — one clock, no extra read
             # at step entry.
             TRACER.step(decode_rows=len(dec), prefill_rows=len(pre),
-                        chunk=self.chunk if pre else 0,
+                        chunk=cw,
                         queue_depth=len(self._queue),
                         wall_ms=(time.perf_counter()
                                  - self._step_t0) * 1e3,
                         key=self.fault_key)
+        if self.admission is not None:
+            # the same wall the timeline records is the policy's signal;
+            # it adapts the NEXT iteration's width (never this one's)
+            self.admission.observe_step(
+                (time.perf_counter() - self._step_t0) * 1e3,
+                len(dec), len(pre))
         return True
 
     def _expire_req(self, req: ServeRequest, code: str = "deadline",
@@ -477,9 +616,10 @@ class Scheduler:
                 # overstate the denominator for requests cancelled or
                 # expired mid-prefill)
 
-    def _prefill_chunk(self, rows: list[_Slot]) -> None:
+    def _prefill_chunk(self, rows: list[_Slot],
+                       width: int | None = None) -> None:
         eng = self.engine
-        b, c = eng.batch, self.chunk
+        b, c = eng.batch, int(width or self.chunk)
         tok = np.zeros((b, c), np.int32)
         pos = np.full((b,), eng.seq_len, np.int32)  # gated rows: writes drop
         lidx = np.zeros((b,), np.int32)
@@ -552,6 +692,9 @@ class Scheduler:
         now = time.perf_counter()
         if req.stats.t_first is None:
             req.stats.t_first = now
+            if self.admission is not None:
+                self.admission.observe_ttft(
+                    (now - req.stats.t_submit) * 1e3)
             if TRACER.enabled:
                 TRACER.event("first_token", req.trace_id,
                              ttft_ms=round((now - req.stats.t_submit)
@@ -623,8 +766,15 @@ class Scheduler:
         eng = self.engine
         with self._mutex:
             gate = np.full((eng.batch,), eng.seq_len, np.int32)
-            eng.slot_prefill_chunk(np.zeros((eng.batch, self.chunk), np.int32),
-                                   gate, np.zeros((eng.batch,), np.int32))
+            # with the SLO-aware policy armed, EVERY ladder rung is a
+            # planned prefill width: warm them all here so an adaptive
+            # run mints zero post-warmup compile keys (the sentinel —
+            # and --freeze-compiles — stay green while the width moves)
+            widths = (self.admission.ladder if self.admission is not None
+                      else [self.chunk])
+            for w in widths:
+                eng.slot_prefill_chunk(np.zeros((eng.batch, w), np.int32),
+                                       gate, np.zeros((eng.batch,), np.int32))
             eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32), gate)
             if self.prefix_cache is not None:
                 # the seed/publish executables compile here too — a

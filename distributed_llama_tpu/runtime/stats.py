@@ -209,6 +209,10 @@ class ServeStats:
     # attached by the Scheduler when the radix prefix cache is on — its
     # summary rides the same /stats payload as a `prefix_cache` block
     prefix: PrefixCacheStats | None = None
+    # attached by the Scheduler when the SLO-aware admission policy is on
+    # (runtime/scheduler.AdmissionPolicy) — current chunk width, EWMAs,
+    # and transition counters ride /stats as an `admission` block
+    admission: object | None = None
 
     def __post_init__(self):
         from collections import deque
@@ -243,6 +247,8 @@ class ServeStats:
         }
         if self.prefix is not None:
             out["prefix_cache"] = self.prefix.summary()
+        if self.admission is not None:
+            out["admission"] = self.admission.summary()
         return out
 
 

@@ -569,6 +569,31 @@ def _add_device_blocks(p: _Prom, summary: dict,
                   type_="counter")
 
 
+def _add_admission(p: _Prom, adm: dict | None, *,
+                   labels: dict | None = None,
+                   prefix: str = "dllama_") -> None:
+    """The SLO-aware admission family (runtime/scheduler.AdmissionPolicy
+    summary): live chunk width + the EWMAs the policy steers on. One
+    renderer for both homes — the top-level supervisor summary and each
+    replica's block (`dllama_replica_admission_*`, replica-labelled)."""
+    if not adm:
+        return
+    per = " (per replica)" if prefix != "dllama_" else ""
+    p.add(f"{prefix}admission_chunk_width", adm.get("chunk_width"),
+          labels,
+          help_=f"Current adaptive chunked-prefill width (tokens){per}")
+    p.add(f"{prefix}admission_chunk_changes_total", adm.get("shrinks"),
+          {**(labels or {}), "direction": "shrink"}, type_="counter",
+          help_=f"Adaptive chunk-width rung transitions{per}")
+    p.add(f"{prefix}admission_chunk_changes_total", adm.get("widens"),
+          {**(labels or {}), "direction": "widen"}, type_="counter")
+    p.add(f"{prefix}admission_itl_ewma_ms", adm.get("itl_ewma_ms"),
+          labels,
+          help_=f"Live inter-token-latency EWMA the policy steers on{per}")
+    p.add(f"{prefix}admission_ttft_ewma_ms", adm.get("ttft_ewma_ms"),
+          labels, help_=f"Live time-to-first-token EWMA{per}")
+
+
 def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                       model: str = "dllama", mode: str = "scheduler",
                       state: str | None = None,
@@ -614,6 +639,26 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
         p.add("dllama_supervisor_recovery_ms", res.get("recovery_p99_ms"),
               {"quantile": "0.99"})
         _add_block(p, summary.get("router"), _ROUTER, type_="counter")
+        auto = summary.get("autosize")
+        if auto:
+            # the startup auto-sizing decision (runtime/profiler.
+            # resolve_auto_shape): what was chosen and why, as gauges an
+            # operator can alert on (a knee drifting under live load
+            # shows up as dllama_step_ms disagreeing with these)
+            p.add("dllama_autosize_serve_batch", auto.get("serve_batch"),
+                  {"basis": _esc(auto.get("serve_batch_basis"))},
+                  help_="Auto-resolved --serve-batch (KV slots)")
+            if auto.get("prefix_blocks_basis") != "static":
+                p.add("dllama_autosize_prefix_blocks",
+                      auto.get("prefix_blocks"),
+                      {"basis": _esc(auto.get("prefix_blocks_basis"))},
+                      help_="Auto-resolved --prefix-blocks (arena blocks)")
+            p.add("dllama_autosize_knee_rows",
+                  (auto.get("inputs") or {}).get("knee_rows"),
+                  {"basis": _esc((auto.get("inputs") or {})
+                                 .get("knee_basis"))},
+                  help_="Batch knee that capped the auto-sizing")
+        _add_admission(p, summary.get("admission"))
         _add_device_blocks(p, summary)
         for rep in summary.get("replicas") or ():
             lab = {"replica": str(rep.get("replica"))}
@@ -632,6 +677,12 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
             _add_block(p, rep.get("prefix_cache"), tuple(
                 (k, n.replace("dllama_", "dllama_replica_"))
                 for k, n in _PREFIX_GAUGES), type_="gauge", labels=lab)
+            # per-replica admission policy state (the router's aggregate
+            # summary carries none — each replica's scheduler owns its
+            # own policy, so the family must ride the replica label or a
+            # multi-replica tier would lose it entirely, the PR-8 rule)
+            _add_admission(p, rep.get("admission"), labels=lab,
+                           prefix="dllama_replica_")
             _add_device_blocks(p, rep, labels=lab)
             proc = rep.get("proc")
             if proc:

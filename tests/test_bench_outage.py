@@ -137,6 +137,68 @@ def test_serve_row_emits_valid_json():
     assert report["hbm"] is not None     # the ledger rode the artifact
 
 
+def test_autotune_row_emits_valid_json():
+    """BENCH_AUTOTUNE=1 adds the closed batch-knee-loop row
+    (bench._autotune_row): inline calibration -> auto-sized batch ->
+    SLO-aware adaptive serve, A/B'd against static settings. The
+    DETERMINISTIC acceptance bars ride the assertions — greedy token
+    parity across every policy and ZERO post-warmup compiles across the
+    adaptive run (the freeze held) — plus artifact structure; the
+    beats-all-static goodput bar is pinned on the COMMITTED
+    BENCH_r06.json row (a timing race on a loaded CI box is not a
+    regression signal, the committed A/B is)."""
+    r = _run_bench({
+        "BENCH_AUTOTUNE": "1",
+        "BENCH_AUTOTUNE_REQUESTS": "8",
+        "BENCH_AUTOTUNE_TOKENS": "8",
+        "BENCH_AUTOTUNE_BATCHES": "2,4",
+        "BENCH_AUTOTUNE_STATIC": "2:16,2:8",
+        "BENCH_AUTOTUNE_REPEATS": "1",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    at = [v for v in row.get("variants", [])
+          if "autotune" in v["metric"]]
+    assert len(at) == 1, row
+    a = at[0]
+    assert a["unit"] == "tok/s" and a["value"] > 0
+    assert a["token_parity"] is True          # greedy outputs identical
+    assert a["compiles_after_warmup"] == 0    # the ladder was all warmed
+    assert a["freeze_compiles"] is True       # ...and the freeze held
+    # the loop's decision record is complete and machine-readable
+    assert a["calibration"]["knee"]["knee_rows"] >= 1
+    assert a["calibration"]["decode_curve"], a["calibration"]
+    assert a["autosize"]["serve_batch"] == a["serve_batch_auto"] >= 1
+    assert a["adaptive"]["adaptive"] is True
+    assert a["adaptive"]["admission"]["chunk_ladder"][0] == 32
+    assert len(a["static"]) == 2
+    assert a["best_static"]["goodput_tok_s"] > 0
+    assert isinstance(a["beats_all_static"], bool)
+    json.dumps(a)  # the row round-trips as machine-readable JSON
+
+    # the committed artifact's acceptance bar: the self-tuned scheduler
+    # met or beat every swept static setting on goodput-at-SLO there
+    committed = json.load(open(os.path.join(REPO, "BENCH_r06.json")))
+    cat = [v for v in committed["variants"] if "autotune" in v["metric"]][0]
+    assert cat["beats_all_static"] is True
+    assert cat["token_parity"] is True
+    assert cat["compiles_after_warmup"] == 0
+
+    # dlprof consumes the committed row + the committed calibration
+    # artifact end to end (the drift machinery over real data)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import dlprof
+
+    art = dlprof.load_autotune(os.path.join(REPO, "AUTOTUNE.json"))
+    report = dlprof.analyze([], [committed] + committed["variants"],
+                            autotune=art)
+    assert report["autotune"]["calibrated_knee_rows"] >= 1
+    assert isinstance(report["autotune"]["drift"], bool)
+
+
 def test_prefix_row_emits_valid_json():
     """BENCH_PREFIX=1 adds the radix prefix-cache row (bench._prefix_row):
     the shared-system-prompt Poisson trace served cache OFF vs ON. The

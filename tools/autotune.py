@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""autotune — measured batch-knee calibration → a versioned AUTOTUNE.json.
+
+The measurement half of the batch-knee loop (ROADMAP item 1): sweep the
+SERVING step shapes — the slot scheduler's real executables, driven
+through a real ``runtime/scheduler.Scheduler`` exactly like bench.py's
+``_serve_row`` so calibration and live ``--trace-dir`` timelines are the
+same units — across batch sizes, fit the composition→ms/step curve with
+``tools/dlprof.py``'s knee estimator, and emit an artifact that:
+
+  * ``dllama api --serve-batch auto --autotune AUTOTUNE.json`` consumes
+    at startup (``runtime/profiler.resolve_auto_shape`` caps the
+    HBM-ledger headroom by the calibrated knee),
+  * ``tools/dlprof.py --autotune`` compares against live step timelines
+    and flags drift (knee moved >= 25% since calibration),
+  * ``BENCH_AUTOTUNE=1 bench.py`` runs inline for the committed A/B row.
+
+Per batch size B the sweep serves B concurrent requests through a fresh
+B-slot scheduler (one full-width prefill chunk each, then a pure decode
+phase at occupancy B) and reads the flight recorder's per-composition
+step histograms; the decode-only composition ``dec{B}_pre0_c0`` is the
+curve point. Supplementary shapes measured on the LARGEST batch:
+
+  * the adaptive chunk-width ladder (``scheduler.chunk_ladder``) —
+    per-width prefill-forward cost, the data behind the SLO policy's
+    shrink/widen tradeoff,
+  * the prefix-cache pass — the same trace re-served with a shared
+    prefix through a radix cache, so seed-path admissions and hit-path
+    step times are in the artifact.
+
+Methodology is backend-agnostic: the same sweep runs on the CPU-tiny
+config in CI smoke form and on real silicon with a production model
+(``--model 7b``); the artifact records backend + model so consumers can
+refuse a mismatched calibration. ``--selftest`` exercises the fit +
+artifact round-trip + both validators with no jax at all (the CI step).
+
+Usage:
+  python tools/autotune.py --model tiny --batches 2,4,8,16,32,64,128 \
+      --out AUTOTUNE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+for _p in (_TOOLS, _REPO):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import dlprof  # noqa: E402 — the knee estimator + artifact validator
+
+AUTOTUNE_KIND = dlprof.AUTOTUNE_KIND
+AUTOTUNE_VERSION = dlprof.AUTOTUNE_VERSION
+DEFAULT_BATCHES = (2, 4, 8, 16, 32, 64, 128)
+# small batches anchor the knee criterion: dlprof's marginal-throughput
+# test references the SMALL-batch per-row throughput — a grid that starts
+# at an already-amortized batch size understates the baseline and calls
+# the knee one rung early
+
+
+def build_artifact(*, model: str, backend: str, jax_version: str,
+                   chunk: int, seq_len: int, steps_per_batch: int,
+                   decode_curve: list[dict],
+                   prefill_ms_by_width: dict | None = None,
+                   prefix: dict | None = None,
+                   hbm: dict | None = None,
+                   created_unix: float | None = None) -> dict:
+    """Assemble + knee-fit the versioned artifact from measured points.
+    Pure (no jax): the selftest builds one from synthetic timings."""
+    curve = [(int(p["rows"]), float(p["p50_ms"])) for p in decode_curve
+             if p.get("p50_ms")]
+    knee = dlprof.knee_estimate(sorted(curve))
+    art = {
+        "kind": AUTOTUNE_KIND,
+        "version": AUTOTUNE_VERSION,
+        "created_unix": (time.time() if created_unix is None
+                         else created_unix),
+        "model": model,
+        "backend": backend,
+        "jax": jax_version,
+        "chunk": int(chunk),
+        "seq_len": int(seq_len),
+        "steps_per_batch": int(steps_per_batch),
+        "decode_curve": decode_curve,
+        "prefill_ms_by_width": prefill_ms_by_width or {},
+        "prefix": prefix or {},
+        "knee": knee,
+        "recommendation": dlprof.serve_batch_recommendation(knee, hbm),
+        "hbm": hbm,
+    }
+    problems = dlprof.validate_autotune(art)
+    if problems:
+        raise ValueError("calibration produced an invalid artifact: "
+                         + "; ".join(problems))
+    return art
+
+
+def _sweep_batch(spec, params, b: int, *, chunk: int, steps: int,
+                 cdt, seq: int, prefix_block_len: int = 16,
+                 with_prefix: bool = False) -> dict:
+    """Serve one batch size through a real scheduler and return its
+    per-composition step timeline (+ prefix-cache stats when asked)."""
+    import gc
+
+    import numpy as np
+
+    from distributed_llama_tpu.runtime.engine import Engine
+    from distributed_llama_tpu.runtime.prefix_cache import PrefixCache
+    from distributed_llama_tpu.runtime.scheduler import Scheduler
+    from distributed_llama_tpu.runtime.trace import TRACER
+    from distributed_llama_tpu.sampler import Sampler
+
+    eng = Engine(spec, params, compute_dtype=cdt, cache_dtype=cdt,
+                 max_seq_len=seq, batch=b)
+    pc = None
+    if with_prefix:
+        pc = PrefixCache(eng, num_blocks=max(2 * b, 8)
+                         * (chunk // prefix_block_len + 1),
+                         block_len=prefix_block_len)
+    sched = Scheduler(eng, chunk=chunk, prefix_cache=pc)
+    sched.warmup()
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, spec.vocab_size, chunk).astype(
+        np.int64).tolist()
+    if with_prefix:
+        # shared-prefix trace: request 0 publishes, the rest seed — the
+        # hit-path admission + seeded steps land on the timeline
+        prompts = [shared + rng.integers(1, spec.vocab_size, 4).astype(
+            np.int64).tolist() for _ in range(b)]
+        prime = sched.submit(prompts[0], 2,
+                             Sampler(spec.vocab_size, temperature=0.0,
+                                     topp=0.9, seed=7))
+        while not prime.finished.is_set():
+            sched.step()
+    else:
+        # one full-width chunk each: every request prefills in a single
+        # (B, chunk) forward, then decodes `steps` tokens — the timeline
+        # is dominated by the decode-only composition at occupancy B
+        prompts = [rng.integers(1, spec.vocab_size, chunk).astype(
+            np.int64).tolist() for _ in range(b)]
+
+    TRACER.reset()
+    TRACER.configure(capacity=8192, decode_every=1 << 30)
+    try:
+        live = [sched.submit(p, steps,
+                             Sampler(spec.vocab_size, temperature=0.0,
+                                     topp=0.9, seed=7))
+                for p in prompts]
+        guard = 0
+        while not all(r.finished.is_set() for r in live):
+            sched.step()
+            guard += 1
+            assert guard < 100 * (steps + chunk), "sweep did not drain"
+        timeline = TRACER.steps.summary_json()
+    finally:
+        TRACER.reset()
+        sched.close()
+    out = {"timeline": timeline}
+    if pc is not None:
+        out["prefix_stats"] = pc.stats.summary()
+    if with_prefix:
+        # the largest engine survives the sweep: the caller reads its
+        # HBM ledger and times the prefill width ladder on it
+        out["engine"], out["pc"] = eng, pc
+    else:
+        del eng
+        gc.collect()
+    return out
+
+
+def _prefill_ladder_ms(engine, chunk: int, repeats: int = 5) -> dict:
+    """Direct per-width cost of the adaptive ladder's prefill forwards
+    (all rows gated — state-neutral, same flops as a live chunk): the
+    shrink/widen tradeoff the SLO policy trades on, in ms."""
+    import numpy as np
+
+    from distributed_llama_tpu.runtime.scheduler import chunk_ladder
+
+    gate = np.full((engine.batch,), engine.seq_len, np.int32)
+    zl = np.zeros((engine.batch,), np.int32)
+    out = {}
+    for w in chunk_ladder(chunk):
+        tok = np.zeros((engine.batch, w), np.int32)
+        engine.slot_prefill_chunk(tok, gate, zl)  # compile off the clock
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            logits = engine.slot_prefill_chunk(tok, gate, zl)
+            logits.block_until_ready()
+            dt = (time.perf_counter() - t0) * 1e3
+            best = dt if best is None else min(best, dt)
+        out[str(w)] = round(best, 4)
+    return out
+
+
+def calibrate(*, model: str = "tiny", batches=DEFAULT_BATCHES,
+              chunk: int = 32, steps: int = 32, seq: int | None = None,
+              spec=None, params=None, log=print) -> dict:
+    """Run the sweep on the current backend and return the artifact.
+    `spec`/`params` override the bench model table (BENCH_AUTOTUNE=1
+    reuses bench.py's already-synthesized weights)."""
+    import jax
+
+    import bench
+    from distributed_llama_tpu.runtime.profiler import hbm_ledger
+
+    if spec is None:
+        spec = {"7b": bench.LLAMA2_7B, "8b": bench.LLAMA3_8B,
+                "13b": bench.LLAMA2_13B, "moe": bench.MIXTRAL_MOE,
+                "grok": bench.GROK1_TRUNC,
+                "70bt": bench.LLAMA2_70B_TRUNC}.get(model, bench.TINY)
+    if params is None:
+        params = bench.synth_q40_params(spec)
+    import jax.numpy as jnp
+
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+    seq = int(seq or min(256, spec.seq_len))
+    batches = sorted({int(b) for b in batches})
+    decode_curve = []
+    largest = None
+    for b in batches:
+        t0 = time.perf_counter()
+        res = _sweep_batch(spec, params, b, chunk=chunk, steps=steps,
+                           cdt=cdt, seq=seq, with_prefix=(b == batches[-1]))
+        comp = res["timeline"].get(f"dec{b}_pre0_c0")
+        if comp:
+            decode_curve.append({"rows": b, "p50_ms": comp["p50_ms"],
+                                 "mean_ms": comp["mean_ms"],
+                                 "n": comp["n"]})
+        log(f"autotune: batch {b}: "
+            f"{comp['p50_ms'] if comp else None} ms/step p50 "
+            f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+        if b == batches[-1]:
+            largest = res
+    prefix = {"timeline": largest["timeline"],
+              "stats": largest.get("prefix_stats")}
+    eng = largest["engine"]
+    hbm = hbm_ledger(eng, largest.get("pc"))
+    ladder_ms = _prefill_ladder_ms(eng, chunk)
+    art = build_artifact(
+        model=model, backend=jax.default_backend(), jax_version=jax.__version__,
+        chunk=chunk, seq_len=seq, steps_per_batch=steps,
+        decode_curve=decode_curve, prefill_ms_by_width=ladder_ms,
+        prefix=prefix, hbm=hbm)
+    del largest, eng
+    import gc
+
+    gc.collect()
+    return art
+
+
+# -- selftest (the CI smoke: fit + artifact contract, no jax) ---------------
+
+
+def _selftest() -> int:
+    import tempfile
+
+    # a synthetic curve with a knee at 4 rows -> artifact round-trip
+    curve = [{"rows": r, "p50_ms": ms, "mean_ms": ms, "n": 32}
+             for r, ms in ((1, 5.0), (2, 5.4), (4, 6.2), (8, 14.0))]
+    art = build_artifact(model="selftest", backend="none",
+                         jax_version="none", chunk=32, seq_len=256,
+                         steps_per_batch=32, decode_curve=curve,
+                         prefill_ms_by_width={"32": 4.0, "16": 2.2},
+                         created_unix=0.0)
+    assert art["knee"]["knee_rows"] == 4, art["knee"]
+    assert art["recommendation"]["serve_batch"] == 4
+    assert not dlprof.validate_autotune(art)
+
+    # BOTH validators accept the artifact after a disk round-trip: the
+    # standalone dlprof one and the canonical runtime/profiler one (the
+    # consumer `--serve-batch auto` trusts) must agree
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "AUTOTUNE.json")
+        with open(path, "w") as f:
+            json.dump(art, f)
+        loaded = dlprof.load_autotune(path)
+        from distributed_llama_tpu.runtime.profiler import (
+            AUTOTUNE_KIND as PK, AUTOTUNE_VERSION as PV, load_autotune)
+        assert (PK, PV) == (AUTOTUNE_KIND, AUTOTUNE_VERSION)
+        assert load_autotune(path)["knee"]["knee_rows"] == 4
+    assert loaded["knee"]["knee_rows"] == 4
+
+    # empty sweep -> a clear error, never a kneeless artifact
+    try:
+        build_artifact(model="x", backend="none", jax_version="none",
+                       chunk=32, seq_len=256, steps_per_batch=1,
+                       decode_curve=[])
+    except ValueError as e:
+        assert "knee" in str(e)
+    else:
+        raise AssertionError("kneeless artifact was not refused")
+    print("autotune selftest: OK (knee=4, both validators agree, "
+          "kneeless sweep refused)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autotune", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "7b", "8b", "13b", "moe", "grok",
+                             "70bt"],
+                    help="bench.py model table entry (synthetic Q40 "
+                         "weights — step time does not depend on values)")
+    ap.add_argument("--batches", default=",".join(map(str,
+                                                      DEFAULT_BATCHES)),
+                    help="comma list of batch sizes to sweep")
+    ap.add_argument("--chunk", type=int, default=32,
+                    help="prefill chunk width (the adaptive ladder's "
+                         "widest rung)")
+    ap.add_argument("--steps", type=int, default=32,
+                    help="decode steps measured per batch size")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="engine context for the sweep (default: "
+                         "min(256, model seq_len))")
+    ap.add_argument("--out", default="AUTOTUNE.json",
+                    help="artifact path (default ./AUTOTUNE.json)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fit + artifact-contract smoke, no jax (CI)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    batches = [int(x) for x in str(args.batches).split(",") if x.strip()]
+    if not batches:
+        ap.error("--batches must name at least one batch size")
+    art = calibrate(model=args.model, batches=batches, chunk=args.chunk,
+                    steps=args.steps, seq=args.seq)
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    rec = art["recommendation"]
+    print(f"autotune: wrote {args.out} — knee={art['knee']['knee_rows']} "
+          f"rows ({art['knee']['method']}), recommended --serve-batch "
+          f"{rec['serve_batch']}"
+          + (f" (HBM caps at {rec['hbm_cap_rows']})"
+             if rec.get("hbm_cap_rows") is not None else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -284,6 +284,77 @@ def knee_estimate(curve: list[tuple[int, float]]) -> dict | None:
             "batches to find the true knee"}
 
 
+# -- the calibration artifact (tools/autotune.py) ---------------------------
+
+# duplicated from runtime/profiler.py on purpose: dlprof must run with NO
+# repo on the path (operators copy it next to an artifact — the same
+# reason percentile() above is local). tests/test_autotune.py pins the
+# two validators against each other so the contract cannot drift.
+AUTOTUNE_KIND = "dllama-autotune"
+AUTOTUNE_VERSION = 1
+DRIFT_FRAC = 0.25  # calibrated vs measured knee movement worth flagging
+
+
+def validate_autotune(art) -> list[str]:
+    """Schema problems of one AUTOTUNE.json artifact (empty = valid)."""
+    problems = []
+    if not isinstance(art, dict):
+        return ["not a JSON object"]
+    if art.get("kind") != AUTOTUNE_KIND:
+        problems.append(f"kind must be {AUTOTUNE_KIND!r}, "
+                        f"got {art.get('kind')!r}")
+    if art.get("version") != AUTOTUNE_VERSION:
+        problems.append(f"version must be {AUTOTUNE_VERSION}, "
+                        f"got {art.get('version')!r}")
+    knee = art.get("knee")
+    if not isinstance(knee, dict) or not knee.get("knee_rows"):
+        problems.append("missing knee.knee_rows (re-run the calibration "
+                        "with >= 1 measured batch size)")
+    if not isinstance(art.get("decode_curve"), list):
+        problems.append("missing decode_curve list")
+    return problems
+
+
+def load_autotune(path: str) -> dict:
+    with open(path) as f:
+        art = json.load(f)
+    problems = validate_autotune(art)
+    if problems:
+        raise ValueError("invalid autotune artifact: "
+                         + "; ".join(problems))
+    return art
+
+
+def autotune_comparison(knee: dict | None, art: dict) -> dict:
+    """Calibrated knee (AUTOTUNE.json) vs the knee measured from the
+    LIVE inputs of this report — the drift check an operator runs before
+    trusting yesterday's calibration: a knee moved >= DRIFT_FRAC means
+    the workload, model, or backend shifted enough that the auto-sized
+    batch is stale and tools/autotune.py should re-run."""
+    calibrated = int((art.get("knee") or {}).get("knee_rows") or 0)
+    measured = int(knee["knee_rows"]) if knee else None
+    drift_frac = None
+    drift = False
+    if measured is not None and calibrated:
+        drift_frac = abs(measured - calibrated) / calibrated
+        drift = drift_frac >= DRIFT_FRAC
+    return {
+        "calibrated_knee_rows": calibrated or None,
+        "calibrated_model": art.get("model"),
+        "calibrated_backend": art.get("backend"),
+        "calibrated_unix": art.get("created_unix"),
+        "measured_knee_rows": measured,
+        "drift_frac": _rnd(drift_frac, 4),
+        "drift": drift,
+        "note": ("no live decode compositions to compare against — "
+                 "feed --trace-dir or a bench artifact"
+                 if measured is None else
+                 (f"knee moved {drift_frac:.0%} from calibration "
+                  "(>= 25%): re-run tools/autotune.py and re-resolve "
+                  "--serve-batch auto" if drift else None)),
+    }
+
+
 def serve_batch_recommendation(knee: dict | None,
                                hbm: dict | None) -> dict | None:
     """The knee, capped by what HBM can actually hold: current batch
@@ -350,7 +421,8 @@ def tail_attribution(paths: list[dict], k: int = 5) -> list[dict]:
 
 
 def analyze(events: list[dict], bench_rows: list[dict] | None = None, *,
-            slo_ttft_ms: float = 500.0, slo_itl_ms: float = 100.0) -> dict:
+            slo_ttft_ms: float = 500.0, slo_itl_ms: float = 100.0,
+            autotune: dict | None = None) -> dict:
     bench_rows = bench_rows or []
     timeline = merge_timelines(events, bench_rows)
     paths = [p for p in (critical_path(s)
@@ -360,7 +432,7 @@ def analyze(events: list[dict], bench_rows: list[dict] | None = None, *,
     knee = knee_estimate(curve)
     hbm = next((r["hbm"] for r in bench_rows
                 if isinstance(r.get("hbm"), dict) and r["hbm"]), None)
-    return {
+    report = {
         "inputs": {"events": len(events), "spans": len(paths),
                    "bench_rows": len(bench_rows),
                    "compositions": len(timeline)},
@@ -379,6 +451,9 @@ def analyze(events: list[dict], bench_rows: list[dict] | None = None, *,
         "tail": tail_attribution(paths),
         "hbm": hbm,
     }
+    if autotune is not None:
+        report["autotune"] = autotune_comparison(knee, autotune)
+    return report
 
 
 def render_markdown(report: dict) -> str:
@@ -421,6 +496,18 @@ def render_markdown(report: dict) -> str:
         lines += ["", f"**Recommended `--serve-batch "
                       f"{rec['serve_batch']}`**{cap}."]
     lines.append("")
+
+    at = report.get("autotune")
+    if at:
+        lines += ["## Calibration drift (AUTOTUNE.json)", "",
+                  f"Calibrated knee {at['calibrated_knee_rows']} rows "
+                  f"({at['calibrated_model']}/{at['calibrated_backend']})"
+                  f" vs measured {at['measured_knee_rows']} — drift "
+                  f"{at['drift_frac']}"
+                  + (" ⚠️ **DRIFTED**" if at["drift"] else " (ok)")
+                  + ".", ""]
+        if at.get("note"):
+            lines += [f"_{at['note']}_", ""]
 
     g = report["goodput"]
     lines += ["## Goodput", "",
@@ -509,7 +596,32 @@ def _selftest() -> int:
     json.dumps(report)                      # JSON-clean
     md = render_markdown(report)
     assert "Knee: 4 rows" in md, md
-    print("dlprof selftest: OK (knee=4, 3 spans, report renders)")
+
+    # the AUTOTUNE.json input path: a matching calibration reads clean, a
+    # knee that moved 2x flags drift in the report AND the markdown
+    art = {"kind": AUTOTUNE_KIND, "version": AUTOTUNE_VERSION,
+           "model": "selftest", "backend": "none", "created_unix": 0.0,
+           "decode_curve": [],
+           "knee": {"knee_rows": 4, "method": "marginal_throughput"}}
+    assert not validate_autotune(art), validate_autotune(art)
+    assert validate_autotune({"kind": "bogus"})  # bad artifact named
+    with tempfile.TemporaryDirectory() as d:
+        ap = os.path.join(d, "AUTOTUNE.json")
+        with open(ap, "w") as f:
+            json.dump(art, f)
+        with open(os.path.join(d, "trace-00000001.jsonl"), "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        r2 = analyze(load_trace_dir(d), [bench_row],
+                     autotune=load_autotune(ap))
+    at = r2["autotune"]
+    assert at["measured_knee_rows"] == 4 and not at["drift"], at
+    drifted = autotune_comparison({"knee_rows": 8},
+                                  dict(art, knee={"knee_rows": 4}))
+    assert drifted["drift"] and drifted["drift_frac"] == 1.0, drifted
+    assert "Calibration drift" in render_markdown(r2)
+    print("dlprof selftest: OK (knee=4, 3 spans, autotune drift check, "
+          "report renders)")
     return 0
 
 
@@ -522,6 +634,11 @@ def main(argv: list[str] | None = None) -> int:
                          "subdirs included)")
     ap.add_argument("--bench", action="append", default=[],
                     help="bench.py artifact JSON (repeatable)")
+    ap.add_argument("--autotune", default=None, metavar="FILE",
+                    help="AUTOTUNE.json calibration artifact "
+                         "(tools/autotune.py): the report compares its "
+                         "calibrated knee against the live measured one "
+                         "and flags >= 25%% drift")
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-itl-ms", type=float, default=100.0)
     ap.add_argument("--out", default=None, metavar="PREFIX",
@@ -539,8 +656,20 @@ def main(argv: list[str] | None = None) -> int:
     rows: list[dict] = []
     for b in args.bench:
         rows += load_bench(b)
+    art = None
+    if args.autotune:
+        try:
+            art = load_autotune(args.autotune)
+        except (OSError, ValueError) as e:
+            ap.error(f"--autotune {args.autotune}: {e}")
     report = analyze(events, rows, slo_ttft_ms=args.slo_ttft_ms,
-                     slo_itl_ms=args.slo_itl_ms)
+                     slo_itl_ms=args.slo_itl_ms, autotune=art)
+    at = report.get("autotune")
+    if at and at["drift"]:
+        print(f"dlprof: ⚠️ knee drift {at['drift_frac']:.0%} — calibrated "
+              f"{at['calibrated_knee_rows']} vs measured "
+              f"{at['measured_knee_rows']} rows (re-run tools/autotune.py)",
+              file=sys.stderr)
     if args.out:
         with open(args.out + ".json", "w") as f:
             json.dump(report, f, indent=1)
