@@ -262,9 +262,10 @@ def _with_step_timeline(row_fn, *args, **kwargs) -> dict:
     row carries the raw measurement ROADMAP item 1's knee search mines).
     Rows that drive the slot scheduler get real per-iteration
     compositions; rows measuring the on-device decode loop get per-run
-    mean samples (see _measure_decode); rows with neither (the cluster
-    control-plane row) carry an empty block. The recorder is reset per
-    row so compositions from different models/batches never mix."""
+    mean samples (see _measure_decode); the cluster control-plane row
+    records its heartbeat round trips under the dec0_pre0_c0
+    composition (its "step" is one PING→PONG). The recorder is reset
+    per row so compositions from different models/batches never mix."""
     TRACER.reset()
     # decode_every huge: the serving rows only need STEP records here —
     # span events would grow the ring without changing the block
@@ -1618,7 +1619,12 @@ def _cluster_chaos_row(prefix: str) -> dict:
     env["JAX_PLATFORMS"] = "cpu"  # the harness never inits a backend
     env.pop("DLLAMA_FAULTS", None)
 
-    def run_pair(worker_extra, faults=""):
+    def launch_pair(phases, worker_extra=(), faults=""):
+        """ONE home for the harness launch/parse/reap protocol (fault
+        and clean runs both ride it — a CLI/framing change must not be
+        made twice). Returns (root events, worker events); a worker
+        whose reader is wedged by a fault never exits on its own and is
+        reaped before its communicate."""
         port = free_port()
         wenv = dict(env)
         if faults:
@@ -1627,7 +1633,7 @@ def _cluster_chaos_row(prefix: str) -> dict:
                   "--worker-timeout", str(w_timeout)]
         root = subprocess.Popen(
             [sys.executable, "-m", harness, "root", "--port", str(port),
-             "--phases", "formation:0.1,decode:60", *common],
+             "--phases", phases, *common],
             env=env, text=True, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL)
         worker = subprocess.Popen(
@@ -1640,18 +1646,30 @@ def _cluster_chaos_row(prefix: str) -> dict:
             if worker.poll() is None:  # wedged reader never exits on its
                 worker.kill()          # own — reap it immediately
             w_out, _ = worker.communicate(timeout=10)
-            r_ev = [json.loads(ln) for ln in r_out.splitlines()
-                    if ln.startswith("{")]
-            w_ev = [json.loads(ln) for ln in w_out.splitlines()
-                    if ln.startswith("{")]
-            lost = next(e for e in r_ev
-                        if e["event"] == "cluster_peer_lost")
-            return lost, w_ev
+            return ([json.loads(ln) for ln in r_out.splitlines()
+                     if ln.startswith("{")],
+                    [json.loads(ln) for ln in w_out.splitlines()
+                     if ln.startswith("{")])
         finally:
             for p in (root, worker):
                 if p.poll() is None:
                     p.kill()
                     p.communicate(timeout=10)
+
+    def run_pair(worker_extra, faults=""):
+        r_ev, w_ev = launch_pair("formation:0.1,decode:60",
+                                 worker_extra, faults)
+        lost = next(e for e in r_ev if e["event"] == "cluster_peer_lost")
+        return lost, w_ev
+
+    def clean_pair(phases: str):
+        """One CLEAN run (no faults, normal shutdown): the wire-ledger
+        source. Returns (root complete.stats, worker shutdown.stats,
+        [tick phase names])."""
+        r_ev, w_ev = launch_pair(phases)
+        return (next(e for e in r_ev if e["event"] == "complete")["stats"],
+                next(e for e in w_ev if e["event"] == "shutdown")["stats"],
+                [e["phase"] for e in w_ev if e["event"] == "tick"])
 
     eof_ms = []
     for _ in range(repeats):
@@ -1667,6 +1685,33 @@ def _cluster_chaos_row(prefix: str) -> dict:
     lost, _ = run_pair([], faults="recv_stall:after=2;times=0")
     stall_wall_s = _time.perf_counter() - t0
     eof_ms.sort()
+
+    # the measured wire plane (dlwire): one clean run's ledger from both
+    # ends, reconciled EXACTLY against frame-size arithmetic — the
+    # protocol frames (phase ticks) have deterministic sizes, so drift
+    # here is 0 by construction or the ledger is broken
+    from distributed_llama_tpu.parallel.multihost import (_HEADER_LEN,
+                                                          frame_bytes)
+    from distributed_llama_tpu.runtime.netstats import reconcile_wire
+    phases = "formation:0.1,tick_a:0.3,tick_b:0.3"
+    root_stats, worker_stats, ticks = clean_pair(phases)
+    w_peer0 = ((worker_stats.get("wire") or {}).get("peers") or {}
+               ).get("0") or {}
+    measured_run_rx = ((w_peer0.get("rx") or {}).get("RUN")
+                       or {"bytes": 0})["bytes"]
+    modeled_run_rx = sum(frame_bytes(_HEADER_LEN, len(name.encode()))
+                         for name in ticks)
+    reconcile = reconcile_wire(measured_run_rx, modeled_run_rx,
+                               unit="bytes")
+    # the row's step_timeline: the control plane's "step" is one
+    # heartbeat round trip — every RTT sample from the clean run's
+    # ledger feeds the dec0/pre0/c0 composition (decode-curve consumers
+    # ignore dec=0 rows by construction; dlprof's wire report reads it)
+    wire = root_stats.get("wire") or {}
+    for peer_rec in (wire.get("peers") or {}).values():
+        for rtt in (peer_rec.get("rtt_ms") or {}).get("recent", ()):
+            TRACER.step(decode_rows=0, prefill_rows=0, chunk=0,
+                        queue_depth=0, wall_ms=rtt)
     return {
         "metric": f"{prefix}_cluster_detect_eof_ms",
         "value": round(eof_ms[len(eof_ms) // 2], 1), "unit": "ms",
@@ -1681,6 +1726,10 @@ def _cluster_chaos_row(prefix: str) -> dict:
         # the acceptance bar rides the row: detection is bounded
         "within_bound": (eof_ms[-1] / 1e3 < w_timeout
                          and lost["last_seen_s"] < w_timeout + 1.0),
+        # the measured cluster wire plane (root + worker ledgers of the
+        # clean run) and the exact control-plane reconciliation
+        "wire": {"root": wire, "worker": worker_stats.get("wire") or {},
+                 "reconcile": reconcile},
     }
 
 
@@ -1947,8 +1996,11 @@ def main() -> None:
                                      prefix=metric.split("_decode")[0]))
             # cluster row (parallel/multihost.py): two-process control-
             # plane chaos — worker death/stall -> structured detection
-            # latency, bounded by --worker-timeout (no scheduler runs, so
-            # its step_timeline block is empty by construction)
+            # latency, bounded by --worker-timeout — plus the measured
+            # wire plane (dlwire): a clean run's per-peer byte/RTT
+            # ledger as the row's `wire` block, heartbeat round trips
+            # as its step_timeline, and the exact frame-arithmetic
+            # reconciliation
             emit(_with_step_timeline(
                 _cluster_chaos_row, prefix=metric.split("_decode")[0]))
 

@@ -858,6 +858,11 @@ def make_handler(state: ApiState):
                 cluster = cluster_summary()
                 if cluster is not None:
                     payload["cluster"] = cluster
+                    # the measured wire ledger, hoisted as its own block
+                    # (dlwire): per-peer bytes/frames by MSG kind and
+                    # direction, heartbeat RTT, clock offsets
+                    if cluster.get("wire"):
+                        payload["wire"] = cluster["wire"]
                 from ..runtime.trace import TRACER
                 if TRACER.enabled:
                     payload["trace"] = TRACER.summary()

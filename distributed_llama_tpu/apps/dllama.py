@@ -630,15 +630,27 @@ def _announce_run(tokens: list[int], max_tokens: int, reset: bool = False,
     """Root side of the multi-host protocol: tell worker processes to enter
     the same generate() call (no-op single-process). lookup > 0 replays a
     speculative run — deterministic draft mining keeps the verify shapes
-    in lock-step."""
+    in lock-step. With the flight recorder on, the run rides one minted
+    trace id (header slot) so the workers' span events (shipped back via
+    MSG_TRACE) land on the root's timeline under it."""
     if jax.process_count() > 1:
         from ..parallel import multihost as mh
+        from ..runtime.trace import TRACER
+
+        tid = 0
+        if TRACER.enabled:
+            tid = TRACER.new_id()
+            link = mh.get_link()
+            if link is not None:
+                link.trace_tid = tid
+            TRACER.event("cluster_tick", tid, phase="run", role="root",
+                         rank=0, n_prompt=len(tokens))
         mh.set_phase("run")
         mh.send_run(tokens, max_tokens,
                     sampler.rng_state if sampler else 0,
                     sampler.temperature if sampler else 0.0,
                     sampler.topp if sampler else 0.0, reset,
-                    lookup=lookup)
+                    lookup=lookup, trace_tid=tid)
 
 
 import contextlib
@@ -973,7 +985,16 @@ def cmd_worker(args) -> None:
     generate() per broadcast run; per-token sync is unnecessary because the
     sampler stream is deterministic and logits are replicated)."""
     from ..parallel import multihost as mh
+    from ..runtime.trace import TRACER
 
+    if getattr(args, "trace", False):
+        # worker-side flight recorder (dlwire): ring only — span events
+        # ship ROOT-ward over MSG_TRACE after each run, so the root's
+        # /admin/trace (or trace sink) is the one merged timeline; a
+        # local sink would just split the story across hosts
+        TRACER.configure(
+            capacity=getattr(args, "trace_buffer", None) or 8192,
+            enabled=True)
     engine, tokenizer, sampler = build_engine(args)
     stops = tokenizer.stop_token_ids()
     api_state = None
@@ -992,6 +1013,19 @@ def cmd_worker(args) -> None:
             return
         if msg.kind == mh.MSG_RUN:
             mh.set_phase("run")
+            tid = msg.trace_tid
+            t_run = time.perf_counter()
+            if TRACER.enabled and tid:
+                # adopt the root's id: advance the local mint counter
+                # past it so this worker's own scheduler-door mints
+                # (MSG_API replays) can never collide with a run tid
+                TRACER.reserve(tid)
+                link = mh.get_link()
+                if link is not None:
+                    link.trace_tid = tid  # a mid-run casualty links here
+                TRACER.event("cluster_tick", tid, phase="run",
+                             role="worker", rank=jax.process_index(),
+                             n_prompt=len(msg.tokens or ()))
             if msg.reset:
                 engine.reset()
             if msg.lookup:
@@ -1026,6 +1060,17 @@ def cmd_worker(args) -> None:
                 else:
                     engine.generate(msg.tokens, msg.max_tokens, run_sampler,
                                     eos_id=stops)
+            if TRACER.enabled and tid:
+                TRACER.event("cluster_tick", tid, phase="run_done",
+                             role="worker", rank=jax.process_index(),
+                             ms=round((time.perf_counter() - t_run) * 1e3,
+                                      3))
+                # one ship per run (tids are per-run unique — no delta
+                # bookkeeping needed): best-effort, the root's casualty
+                # path covers a worker that dies before shipping
+                lk = mh.get_link()
+                if lk is not None and hasattr(lk, "ship_trace"):
+                    lk.ship_trace(TRACER.export_span(tid))
         elif msg.kind == mh.MSG_API:
             mh.set_phase("api")
             # replay the root's API request end-to-end from the raw body —

@@ -29,6 +29,18 @@ registry loads it at import — runtime/faults.py): e.g.
 answering heartbeats, ``conn_refused:times=2`` fails the first two connect
 attempts to exercise the formation backoff.
 
+``--trace`` arms the flight recorder on either side (dlwire): the root
+mints ONE trace id for the session and rides it in every phase frame's
+header; the worker records a ``cluster_tick`` span event per frame and
+ships the new events root-ward in ``MSG_TRACE`` frames, which the root
+rebases (clock-offset estimate) onto its own timeline. The root then
+emits a ``trace_dump`` JSON line — its merged ring, wall-stamped — on
+completion AND on a peer loss (the casualty path: the dump carries the
+root-side ``cluster_lost`` event linked under the same id, exactly what
+``/admin/trace?id=`` would serve on an api root). The ``complete`` /
+``shutdown`` stats now carry the measured wire ledger (bytes + frames
+per peer/kind/direction, heartbeat RTT, clock offset).
+
 Usage:
   python -m distributed_llama_tpu.parallel.cluster_harness root \
       --port 19000 --nnodes 2 --heartbeat-interval 0.2 --worker-timeout 1.5 \
@@ -56,8 +68,28 @@ def _emit(event: str, **fields) -> None:
           flush=True)
 
 
+def _emit_trace_dump(tid: int) -> None:
+    """Dump the tracer's ring, wall-stamped, as one JSON line — the
+    harness's stand-in for GET /admin/trace (same event shape, same
+    anchor): cross-node linkage asserts read this from stdout, which
+    survives the os._exit a peer loss takes."""
+    from ..runtime.trace import TRACER
+
+    if not TRACER.enabled:
+        return
+    events = [{**e, "ts_wall": TRACER.to_wall(e["ts"])}
+              for e in TRACER.recent(0)]
+    _emit("trace_dump", tid=tid, anchor_wall=TRACER.anchor_wall,
+          events=events)
+
+
+_TRACE_TID = [0]  # the session's minted id, readable from the lost path
+
+
 def _exit_on_peer_lost(exc: mh.ClusterPeerLost) -> None:
     _emit(**exc.summary())
+    # the casualty event (multihost._report_lost) is already in the ring
+    _emit_trace_dump(_TRACE_TID[0])
     os._exit(mh.EXIT_PEER_LOST)
 
 
@@ -70,10 +102,22 @@ def _parse_phases(spec: str) -> list[tuple[str, float]]:
 
 
 def run_root(args) -> int:
+    from ..runtime.trace import TRACER
+
     link = mh.RootLink(args.nnodes, "", args.port,
                        heartbeat_interval=args.heartbeat_interval,
                        worker_timeout=args.worker_timeout,
                        connect_timeout=args.connect_timeout)
+    tid = 0
+    if args.trace:
+        # arm BEFORE form() so the casualty path can always link, then
+        # mint ONE id for the whole session — every phase frame carries
+        # it, so root ticks, worker ticks (shipped back via MSG_TRACE),
+        # and a peer-loss casualty all land under one span
+        TRACER.configure(enabled=True)
+        tid = TRACER.new_id()
+        _TRACE_TID[0] = tid
+        link.trace_tid = tid
     try:
         link.form()
     except mh.ClusterProtocolError as e:
@@ -81,27 +125,38 @@ def run_root(args) -> int:
         return mh.EXIT_FORMATION
     mh.set_link(link)
     link.on_peer_lost = _exit_on_peer_lost
+    if tid:
+        TRACER.event("handshake", tid, role="root",
+                     peers=sorted(link.peers))
     _emit("formed", role="root", peers=sorted(link.peers))
     for name, secs in _parse_phases(args.phases):
         link.set_phase(name)
         # a real protocol frame per phase so the broadcast path (and its
         # lost-peer raise) is exercised, not just the heartbeat — the
         # payload carries the phase name so the worker's diagnostics
-        # agree with the root's
-        mh._send(mh.MSG_RUN, bytes_payload=name.encode())
+        # agree with the root's, and the header carries the trace id
+        mh._send(mh.MSG_RUN, bytes_payload=name.encode(), trace_tid=tid)
+        if tid:
+            TRACER.event("cluster_tick", tid, phase=name, role="root",
+                         rank=0)
         time.sleep(secs)
     mh.send_shutdown()
-    _emit("complete", stats=link.summary())
+    _emit("complete", stats=link.summary(), tid=tid)
+    _emit_trace_dump(tid)
     link.close()
     return 0
 
 
 def run_worker(args) -> int:
+    from ..runtime.trace import TRACER
+
     link = mh.WorkerLink(args.host, args.port, args.rank, args.nnodes,
                          heartbeat_interval=args.heartbeat_interval,
                          worker_timeout=args.worker_timeout,
                          connect_timeout=args.connect_timeout,
                          protocol_version=args.protocol_version)
+    if args.trace:
+        TRACER.configure(enabled=True)
     try:
         link.form()
     except mh.ClusterProtocolError as e:
@@ -119,6 +174,8 @@ def run_worker(args) -> int:
             _emit("dying")
             os._exit(9)  # abrupt, like a SIGKILL/OOM — no FIN handshake code
         threading.Thread(target=die, daemon=True).start()
+    shipped = 0  # span events already shipped root-ward (delta ships —
+    #              re-sending the whole span would duplicate on ingest)
     while True:
         msg = mh.recv_msg()
         if msg.kind == mh.MSG_SHUTDOWN:
@@ -128,6 +185,21 @@ def run_worker(args) -> int:
         if msg.kind == mh.MSG_RUN:
             phase = (msg.body or b"?").decode()
             link.set_phase(phase)
+            tid = msg.trace_tid
+            if TRACER.enabled and tid:
+                TRACER.reserve(tid)  # root-minted id: keep local mints
+                #                      disjoint (Tracer.reserve)
+                link.trace_tid = tid
+                _TRACE_TID[0] = tid
+                TRACER.event("cluster_tick", tid, phase=phase,
+                             role="worker", rank=args.rank)
+                # ship per tick, not at shutdown: the root stops reading
+                # after its SHUTDOWN broadcast, and a worker that DIES
+                # mid-session has at least its earlier ticks on the
+                # root's timeline (the casualty span covers the rest)
+                span = TRACER.export_span(tid)
+                if len(span) > shipped and link.ship_trace(span[shipped:]):
+                    shipped = len(span)
             _emit("tick", phase=phase)
 
 
@@ -148,6 +220,11 @@ def main(argv=None) -> int:
                    help="root: comma list of name:seconds cluster phases")
     p.add_argument("--die-after", type=float, default=None,
                    help="worker: os._exit(9) after this many seconds")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the flight recorder: root mints one trace "
+                        "id, workers ship cluster_tick spans back via "
+                        "MSG_TRACE, both dump the merged ring as a "
+                        "trace_dump JSON line")
     args = p.parse_args(argv)
     try:
         return run_root(args) if args.role == "root" else run_worker(args)

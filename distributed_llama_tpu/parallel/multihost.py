@@ -64,7 +64,7 @@ import jax
 
 from ..runtime.faults import FAULTS
 
-# message kinds (root -> workers, except PONG)
+# message kinds (root -> workers, except PONG and TRACE)
 MSG_SHUTDOWN = 0
 MSG_RUN = 1       # one engine.generate(): tokens + budget + sampling params
 MSG_API = 2       # one API request: raw JSON body bytes
@@ -75,13 +75,28 @@ MSG_SEED = 5      # startup handshake: cluster-wide sampler seed
 MSG_HELLO = 6     # worker -> root: version + rank + pid
 MSG_HELLO_ACK = 7  # root -> worker: version/status + adopted timing
 MSG_PING = 8      # root -> worker heartbeat
-MSG_PONG = 9      # worker -> root heartbeat reply
+MSG_PONG = 9      # worker -> root heartbeat reply: [seq, worker wall µs]
+MSG_TRACE = 10    # worker -> root: flight-recorder span ship (JSON
+#                   payload of wall-stamped events; the root rebases them
+#                   onto its own timeline via the PING/PONG-midpoint
+#                   clock-offset estimate — the cluster twin of the
+#                   replica tier's RMSG_TRACE)
+
+# MSG kind -> ledger label (the `kind` label of dllama_wire_bytes_total)
+MSG_NAMES = {
+    MSG_SHUTDOWN: "SHUTDOWN", MSG_RUN: "RUN", MSG_API: "API",
+    MSG_XFER_BENCH: "XFER_BENCH", MSG_SEED: "SEED", MSG_HELLO: "HELLO",
+    MSG_HELLO_ACK: "HELLO_ACK", MSG_PING: "PING", MSG_PONG: "PONG",
+    MSG_TRACE: "TRACE",
+}
 
 # [kind, n_payload, payload_is_bytes, max_tokens, seed_lo, seed_hi,
-#  temp_bits, topp_bits, reset, lookup]
-_HEADER_LEN = 10
+#  temp_bits, topp_bits, reset, lookup, trace_tid]
+_HEADER_LEN = 11
 
-PROTOCOL_VERSION = 1
+# v2: protocol header grew the trace_tid slot and PONG carries the
+# worker's wall clock (dlwire) — mixed builds fail the HELLO symmetric
+PROTOCOL_VERSION = 2
 
 # diagnostic exit codes (documented in docs/operations.md): distinct from
 # generic failure (1) so operators and supervisors can tell "a peer died
@@ -134,38 +149,64 @@ class ClusterProtocolError(RuntimeError):
 
 # -- frame codec -----------------------------------------------------------
 
+def frame_bytes(n_ints: int, n_payload: int) -> int:
+    """Exact on-the-wire size of one frame — header + 8 bytes per int +
+    payload. The reconciliation tests (and the bench cluster row) pin
+    the measured ledger against this arithmetic: the codec owns the
+    format, so the model lives next to it."""
+    return _FRAME_HDR.size + 8 * int(n_ints) + int(n_payload)
+
+
 def _send_frame(sock: socket.socket, kind: int, ints=(), payload: bytes = b"",
-                timeout: float | None = None) -> None:
+                timeout: float | None = None, acct=None) -> None:
     """One framed send with a per-socket deadline. The caller serializes
     concurrent senders (per-peer send lock). Fault sites: frame_truncate
     (half the bytes then close — the peer sees a torn frame), peer_close
-    (close without writing)."""
+    (close without writing).
+
+    ``acct(kind, nbytes)`` is the wire-ledger hook: called EXACTLY ONCE
+    per frame attempt (a finally, so fault paths account too) with the
+    bytes actually handed to the kernel — a torn frame counts its
+    partial bytes once, a peer_close counts zero, and a sendall that
+    raises mid-write counts zero (the kernel's share is unknowable; the
+    ledger under-reports rather than guesses)."""
     ints = [int(v) for v in ints]
     buf = _FRAME_HDR.pack(_FRAME_MAGIC, kind, len(ints), len(payload))
     if ints:
         buf += struct.pack(f"<{len(ints)}q", *ints)
     buf += payload
     sock.settimeout(timeout)
-    if FAULTS.triggered("frame_truncate"):
-        try:
-            sock.sendall(buf[: max(1, len(buf) // 2)])
-        finally:
+    sent = 0
+    try:
+        if FAULTS.triggered("frame_truncate"):
+            part = buf[: max(1, len(buf) // 2)]
+            try:
+                sock.sendall(part)
+                sent = len(part)
+            finally:
+                sock.close()
+            raise ClusterProtocolError("injected frame_truncate")
+        if FAULTS.triggered("peer_close"):
             sock.close()
-        raise ClusterProtocolError("injected frame_truncate")
-    if FAULTS.triggered("peer_close"):
-        sock.close()
-        raise ClusterProtocolError("injected peer_close")
-    sock.sendall(buf)
+            raise ClusterProtocolError("injected peer_close")
+        sock.sendall(buf)
+        sent = len(buf)
+    finally:
+        if acct is not None and sent:
+            acct(kind, sent)
 
 
 def _recv_exact(sock: socket.socket, n: int, deadline: float | None, *,
-                allow_eof: bool = False) -> bytes | None:
+                allow_eof: bool = False, got_box: list | None = None
+                ) -> bytes | None:
     """Read exactly n bytes before an ABSOLUTE monotonic deadline. The
     per-chunk socket timeout is re-armed to the REMAINING budget, so a
     peer trickling one byte per timeout window cannot stretch a frame
     read unboundedly — the whole-frame bound is what the detection
     contract advertises. EOF at a frame boundary returns None when
-    allowed (clean close); EOF mid-read is a torn frame and raises."""
+    allowed (clean close); EOF mid-read is a torn frame and raises.
+    ``got_box[0]`` accumulates bytes actually read (the ledger's truth
+    even when the read dies mid-frame)."""
     chunks: list[bytes] = []
     got = 0
     while got < n:
@@ -183,35 +224,52 @@ def _recv_exact(sock: socket.socket, n: int, deadline: float | None, *,
                 f"truncated frame: EOF after {got}/{n} bytes")
         chunks.append(chunk)
         got += len(chunk)
+        if got_box is not None:
+            got_box[0] += len(chunk)
     return b"".join(chunks)
 
 
-def _recv_frame(sock: socket.socket, timeout: float | None
+def _recv_frame(sock: socket.socket, timeout: float | None, acct=None
                 ) -> tuple[int, list[int], bytes] | None:
     """One framed recv under ONE whole-frame deadline (header + ints +
     payload share it). Returns None on a clean EOF at a frame boundary;
     raises socket.timeout past the deadline and ClusterProtocolError on a
     torn/garbled frame. Fault site: recv_stall (wedges this reader like a
     hung peer — it stops answering heartbeats, so only the PING/PONG
-    timeout on the OTHER side detects it)."""
-    FAULTS.fire("recv_stall")
-    deadline = None if timeout is None else time.monotonic() + timeout
-    sock.settimeout(timeout)
-    hdr = _recv_exact(sock, _FRAME_HDR.size, deadline, allow_eof=True)
-    if hdr is None:
-        return None
-    magic, kind, n_ints, n_pay = _FRAME_HDR.unpack(hdr)
-    if magic != _FRAME_MAGIC:
-        raise ClusterProtocolError(f"bad frame magic 0x{magic:08x}")
-    if n_ints > _MAX_INTS or n_pay > _MAX_PAYLOAD:
-        raise ClusterProtocolError(
-            f"implausible frame header (ints={n_ints}, payload={n_pay})")
-    ints: list[int] = []
-    if n_ints:
-        raw = _recv_exact(sock, 8 * n_ints, deadline)
-        ints = list(struct.unpack(f"<{n_ints}q", raw))
-    payload = _recv_exact(sock, n_pay, deadline) if n_pay else b""
-    return kind, ints, payload
+    timeout on the OTHER side detects it).
+
+    ``acct(kind_or_None, nbytes)`` mirrors the send hook: called exactly
+    once per frame attempt with the bytes actually read — a frame torn
+    mid-payload counts its partial bytes once, under the parsed kind
+    when the header survived (None otherwise)."""
+    got = [0]
+    kind = None
+    try:
+        # stall fires BEFORE the deadline is armed (as pre-ledger): the
+        # whole-frame bound covers the read, not an injected wedge
+        FAULTS.fire("recv_stall")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        sock.settimeout(timeout)
+        hdr = _recv_exact(sock, _FRAME_HDR.size, deadline, allow_eof=True,
+                          got_box=got)
+        if hdr is None:
+            return None
+        magic, kind, n_ints, n_pay = _FRAME_HDR.unpack(hdr)
+        if magic != _FRAME_MAGIC:
+            raise ClusterProtocolError(f"bad frame magic 0x{magic:08x}")
+        if n_ints > _MAX_INTS or n_pay > _MAX_PAYLOAD:
+            raise ClusterProtocolError(
+                f"implausible frame header (ints={n_ints}, payload={n_pay})")
+        ints: list[int] = []
+        if n_ints:
+            raw = _recv_exact(sock, 8 * n_ints, deadline, got_box=got)
+            ints = list(struct.unpack(f"<{n_ints}q", raw))
+        payload = (_recv_exact(sock, n_pay, deadline, got_box=got)
+                   if n_pay else b"")
+        return kind, ints, payload
+    finally:
+        if acct is not None and got[0]:
+            acct(kind, got[0])
 
 
 def control_port(coordinator: str) -> int:
@@ -249,6 +307,13 @@ class _Peer:
         self.last_seen = _now()
         self.send_lock = threading.Lock()
         self.alive = True
+        # wire-ledger hooks (set after _init_stats — formation frames ride
+        # before the stats object exists, documented ledger scope)
+        self.acct_send = None
+        self.acct_recv = None
+        # in-flight PING seq -> (mono, wall) send stamps, for the RTT /
+        # clock-offset estimate; bounded (stale seqs pruned on insert)
+        self.ping_sent: dict[int, tuple] = {}
 
     def close(self) -> None:
         for s in (self.sock, self.send_sock):
@@ -270,6 +335,10 @@ class _LinkBase:
         self.heartbeat_interval = float(heartbeat_interval)
         self.worker_timeout = float(worker_timeout)
         self.phase = "formation"
+        # the trace id the current protocol activity rides (set by the
+        # driver — harness root / _announce_run); a ClusterPeerLost
+        # casualty span links under it
+        self.trace_tid = 0
         self.lost: dict[int, ClusterPeerLost] = {}
         # callback invoked ONCE per lost peer, from the detecting thread
         # (receiver/heartbeat — the main thread may be wedged in a
@@ -302,6 +371,18 @@ class _LinkBase:
             if self.lost and not self._closing:
                 raise next(iter(self.lost.values()))
 
+    def _mk_acct(self, peer_rank: int, direction: str):
+        """One wire-ledger accounting closure for the codec hooks: a
+        no-op until _init_stats built the ClusterStats (formation frames
+        are out of ledger scope by design)."""
+        def acct(kind, nbytes):
+            st = self.stats
+            if st is not None:
+                st.wire.account(peer_rank,
+                                MSG_NAMES.get(kind, str(kind)),
+                                direction, nbytes)
+        return acct
+
     def _report_lost(self, exc: ClusterPeerLost) -> bool:
         """Record + notify exactly once per peer. Returns True when this
         call was the first detection."""
@@ -311,6 +392,16 @@ class _LinkBase:
             self.lost[exc.node_id] = exc
         if self.stats is not None:
             self.stats.peers_lost.append(exc.summary())
+        from ..runtime.trace import TRACER
+
+        if TRACER.enabled:
+            # the casualty span: a lost peer lands on the SAME timeline
+            # (and trace id) as the protocol activity it died under —
+            # the cluster twin of a SIGKILLed replica's worker_exit event
+            TRACER.event("cluster_lost", self.trace_tid,
+                         node=exc.node_id, reason=exc.reason,
+                         phase=exc.phase,
+                         last_seen_s=round(exc.last_seen, 3))
         cb = self.on_peer_lost
         if cb is not None:
             cb(exc)
@@ -383,6 +474,8 @@ class RootLink(_LinkBase):
         # a healthy staggered join would false-positive instantly
         for peer in self.peers.values():
             peer.last_seen = _now()
+            peer.acct_send = self._mk_acct(peer.rank, "tx")
+            peer.acct_recv = self._mk_acct(peer.rank, "rx")
         for peer in self.peers.values():
             t = threading.Thread(target=self._receiver, args=(peer,),
                                  name=f"dllama-cluster-recv-r{peer.rank}",
@@ -454,7 +547,8 @@ class RootLink(_LinkBase):
             wait = max(0.05,
                        peer.last_seen + self.worker_timeout - _now())
             try:
-                frame = _recv_frame(peer.sock, timeout=wait)
+                frame = _recv_frame(peer.sock, timeout=wait,
+                                    acct=peer.acct_recv)
             except socket.timeout:
                 self._lost(peer, "timeout")
                 return
@@ -478,6 +572,51 @@ class RootLink(_LinkBase):
                 self.stats.frames_received += 1
                 if frame[0] == MSG_PONG:
                     self.stats.pongs_received += 1
+                    self._note_pong(peer, frame[1])
+            if frame[0] == MSG_TRACE:
+                self._ingest_trace(peer, frame[2])
+
+    def _note_pong(self, peer: _Peer, ints: list[int]) -> None:
+        """One PONG: match it to its PING's send stamps for the RTT
+        sample, and — when the worker echoed its wall clock — refresh
+        the midpoint clock-offset estimate (offset = worker wall at the
+        midpoint of the round trip minus local wall; kept at the best
+        i.e. minimum-RTT sample — the NTP pick)."""
+        if not ints:
+            return
+        stamp = peer.ping_sent.pop(int(ints[0]), None)
+        if stamp is None:
+            return
+        mono_send, wall_send = stamp
+        rtt_ms = (_now() - mono_send) * 1e3
+        offset_s = None
+        if len(ints) > 1 and ints[1]:
+            wall_mid = (wall_send + time.time()) / 2.0
+            offset_s = ints[1] / 1e6 - wall_mid
+        self.stats.wire.rtt(peer.rank, rtt_ms, offset_s)
+
+    def _ingest_trace(self, peer: _Peer, payload: bytes) -> None:
+        """One MSG_TRACE frame: merge the worker's wall-stamped span
+        events onto the local tracer's timeline, shifted by the per-peer
+        clock-offset estimate so cross-host events sort to within the
+        offset estimate's error (~RTT/2)."""
+        from ..runtime.trace import TRACER
+
+        if not TRACER.enabled:
+            return
+        try:
+            import json
+
+            events = json.loads(payload.decode())["events"]
+            assert isinstance(events, list)
+        except (ValueError, KeyError, AssertionError, UnicodeDecodeError):
+            return  # a malformed ship is observability loss, not a fault
+        off = (self.stats.wire.clock_offset_s(peer.rank)
+               if self.stats is not None else None)
+        if off:
+            events = [{**e, "ts_wall": e["ts_wall"] - off}
+                      for e in events if "ts_wall" in e]
+        TRACER.ingest(events, origin=f"node{peer.rank}")
 
     def _heartbeat(self) -> None:
         # ping FIRST, then sleep: the formation-complete ping reaches
@@ -491,9 +630,27 @@ class RootLink(_LinkBase):
                 if not peer.alive:
                     continue
                 try:
+                    # stamp BEFORE the send: the RTT sample must include
+                    # the send syscall (the peer's PONG races the stamp
+                    # otherwise); stale seqs (unanswered pings) pruned
+                    # so a wedged peer cannot grow the dict unboundedly.
+                    # The receiver thread pops matched seqs lock-free
+                    # concurrently, so the prune must tolerate losing
+                    # the race (default pop; StopIteration/RuntimeError
+                    # if the dict empties/mutates under the iterator) —
+                    # an uncaught error here would kill the heartbeat
+                    # thread and tear the whole cluster down
+                    peer.ping_sent[seq] = (_now(), time.time())
+                    while len(peer.ping_sent) > 64:
+                        try:
+                            peer.ping_sent.pop(
+                                next(iter(peer.ping_sent)), None)
+                        except (StopIteration, RuntimeError):
+                            break
                     with peer.send_lock:
                         _send_frame(peer.send_sock, MSG_PING, [seq],
-                                    timeout=self.worker_timeout)
+                                    timeout=self.worker_timeout,
+                                    acct=peer.acct_send)
                     if self.stats is not None:
                         self.stats.pings_sent += 1
                 except (OSError, ClusterProtocolError) as e:
@@ -525,7 +682,8 @@ class RootLink(_LinkBase):
             try:
                 with peer.send_lock:
                     _send_frame(peer.send_sock, kind, ints, payload,
-                                timeout=self.worker_timeout)
+                                timeout=self.worker_timeout,
+                                acct=peer.acct_send)
                 if self.stats is not None:
                     self.stats.frames_sent += 1
             except (OSError, ClusterProtocolError) as e:
@@ -561,6 +719,8 @@ class WorkerLink(_LinkBase):
         self._protocol_version = int(protocol_version)
         self.sock: socket.socket | None = None
         self._send_lock = threading.Lock()
+        self._acct_send = None
+        self._acct_recv = None
         self._queue: list[tuple[int, list[int], bytes]] = []
         self._cond = threading.Condition()
         self._last_seen = _now()
@@ -633,6 +793,8 @@ class WorkerLink(_LinkBase):
         self.connect_timeout = connect_ms / 1e3
         self._last_seen = _now()
         self._init_stats(connect_retries=self.connect_retries)
+        self._acct_send = self._mk_acct(0, "tx")
+        self._acct_recv = self._mk_acct(0, "rx")
         t = threading.Thread(target=self._receiver,
                              name="dllama-cluster-recv-root", daemon=True)
         t.start()
@@ -650,7 +812,8 @@ class WorkerLink(_LinkBase):
                 0.0 if saw_frame else self.connect_timeout)
             wait = max(0.05, self._last_seen + budget - _now())
             try:
-                frame = _recv_frame(self.sock, timeout=wait)
+                frame = _recv_frame(self.sock, timeout=wait,
+                                    acct=self._acct_recv)
             except socket.timeout:
                 self._root_lost("timeout")
                 return
@@ -675,9 +838,15 @@ class WorkerLink(_LinkBase):
                 self.stats.frames_received += 1
             if kind == MSG_PING:
                 try:
+                    # echo the seq + this worker's wall clock (µs): the
+                    # root's midpoint estimate of the clock offset is
+                    # what MSG_TRACE span rebasing rides
+                    pong = [frame[1][0] if frame[1] else 0,
+                            int(time.time() * 1e6)]
                     with self._send_lock:
-                        _send_frame(self.sock, MSG_PONG, frame[1],
-                                    timeout=self.worker_timeout)
+                        _send_frame(self.sock, MSG_PONG, pong,
+                                    timeout=self.worker_timeout,
+                                    acct=self._acct_send)
                     if self.stats is not None:
                         self.stats.pongs_sent += 1
                 except (OSError, ClusterProtocolError) as e:
@@ -726,6 +895,33 @@ class WorkerLink(_LinkBase):
                         f"no protocol frame within {timeout:.1f}s")
                 self._cond.wait(timeout=0.1)
             return self._queue.pop(0)
+
+    def ship_trace(self, events: list[dict]) -> bool:
+        """Best-effort worker→root span ship (MSG_TRACE): the events are
+        ``Tracer.export_span`` output (wall-stamped — monotonic clocks do
+        not transfer between hosts; the root rebases via its clock-offset
+        estimate for this peer). Returns False instead of raising on any
+        failure: a span that cannot ship is observability loss, never a
+        reason to take the worker down — the root's casualty machinery
+        covers a worker that dies before shipping."""
+        if self.sock is None or self._closing or not events:
+            return False
+        import json
+
+        try:
+            payload = json.dumps({"events": events}).encode()
+        except (TypeError, ValueError):
+            return False
+        try:
+            with self._send_lock:
+                _send_frame(self.sock, MSG_TRACE, [len(events)], payload,
+                            timeout=self.worker_timeout,
+                            acct=self._acct_send)
+            if self.stats is not None:
+                self.stats.frames_sent += 1
+            return True
+        except (OSError, ClusterProtocolError):
+            return False
 
     def close(self) -> None:
         with self._lock:
@@ -857,13 +1053,35 @@ def _bcast(arr: np.ndarray) -> np.ndarray:
     return np.asarray(multihost_utils.broadcast_one_to_all(arr))
 
 
+def _note_bcast(what: str, ms: float, nbytes: int = 0) -> None:
+    """Record one startup data-plane broadcast into the cluster ledger
+    (the bytes ride XLA collectives the socket ledger cannot see — the
+    host-side wall and payload size are what this plane CAN measure) and
+    onto the trace timeline when the recorder is on."""
+    link = _LINK
+    if link is not None and link.stats is not None:
+        st = link.stats
+        if what == "spec":
+            st.bcast_spec_ms = round((st.bcast_spec_ms or 0.0) + ms, 3)
+        else:
+            st.bcast_tensors_ms = round(
+                (st.bcast_tensors_ms or 0.0) + ms, 3)
+            st.bcast_tensors_bytes += int(nbytes)
+    from ..runtime.trace import TRACER
+
+    if TRACER.enabled:
+        TRACER.event("bcast", getattr(link, "trace_tid", 0) or 0,
+                     what=what, ms=round(ms, 3), bytes=int(nbytes))
+
+
 class RunMsg:
     """One decoded protocol message."""
 
     def __init__(self, kind: int, tokens=None, body: bytes | None = None,
                  ints=None, max_tokens: int = 0, seed: int = 0,
                  temperature: float = 0.0, topp: float = 0.0,
-                 reset: bool = False, lookup: int = 0):
+                 reset: bool = False, lookup: int = 0,
+                 trace_tid: int = 0):
         self.kind = kind
         self.tokens = tokens
         self.body = body
@@ -874,6 +1092,7 @@ class RunMsg:
         self.topp = topp
         self.lookup = lookup
         self.reset = reset
+        self.trace_tid = trace_tid
 
 
 def _require_link() -> RootLink | WorkerLink:
@@ -886,7 +1105,8 @@ def _require_link() -> RootLink | WorkerLink:
 
 def _send(kind: int, *, int_payload=None, bytes_payload: bytes | None = None,
           max_tokens: int = 0, seed: int = 0, temperature: float = 0.0,
-          topp: float = 0.0, reset: bool = False, lookup: int = 0) -> None:
+          topp: float = 0.0, reset: bool = False, lookup: int = 0,
+          trace_tid: int = 0) -> None:
     assert int_payload is None or bytes_payload is None
     n = (len(int_payload) if int_payload is not None
          else len(bytes_payload) if bytes_payload is not None else 0)
@@ -897,6 +1117,7 @@ def _send(kind: int, *, int_payload=None, bytes_payload: bytes | None = None,
         int(np.float32(topp).view(np.int32)),
         int(reset),
         int(lookup),
+        int(trace_tid),
     ]
     if int_payload is not None:
         payload = np.asarray(int_payload, "<i8").tobytes()
@@ -928,6 +1149,7 @@ def recv_msg(timeout: float | None = None) -> RunMsg:
         topp=float(np.int32(h[7]).view(np.float32)),
         reset=bool(h[8]),
         lookup=int(h[9]),
+        trace_tid=int(h[10]),
     )
     if n:
         if is_bytes:
@@ -943,15 +1165,17 @@ def recv_msg(timeout: float | None = None) -> RunMsg:
 
 def send_run(tokens: list[int], max_tokens: int, seed: int,
              temperature: float, topp: float, reset: bool = False,
-             lookup: int = 0) -> None:
+             lookup: int = 0, trace_tid: int = 0) -> None:
     """Root: announce one generate() run. seed carries the root sampler's
     CURRENT rng state, so workers reproduce the token stream even when
     their own sampler flags differ. lookup > 0 = the run speculates with
     that draft length: drafts are mined from the (replicated) token
     stream, so every process mines the SAME drafts and the verify-forward
-    shapes stay in lock-step across the cluster."""
+    shapes stay in lock-step across the cluster. trace_tid links the
+    workers' span events to the root's timeline (0 = untraced)."""
     _send(MSG_RUN, int_payload=tokens, max_tokens=max_tokens, seed=seed,
-          temperature=temperature, topp=topp, reset=reset, lookup=lookup)
+          temperature=temperature, topp=topp, reset=reset, lookup=lookup,
+          trace_tid=trace_tid)
 
 
 def send_api(body_json: bytes) -> None:
@@ -1026,7 +1250,9 @@ def bcast_spec(spec, model_fp: int = 0, push: bool = False):
                   model_fp & 0xFFFFFFFF, int(push)]
     else:
         fields = [0] * 16
+    t0 = time.perf_counter()
     f = _bcast(np.asarray(fields, np.int64))
+    _note_bcast("spec", (time.perf_counter() - t0) * 1e3)
     out = ModelSpec(
         arch=ArchType(int(f[0])), dim=int(f[1]), hidden_dim=int(f[2]),
         n_layers=int(f[3]), n_heads=int(f[4]), n_kv_heads=int(f[5]),
@@ -1061,6 +1287,8 @@ def bcast_model_tensors(spec, path: str | None):
                 read_spec(path, spec.weights_float_type), "_header_size")
         f = open(path, "rb")
         f.seek(header_size)
+    total_ms = 0.0
+    total_bytes = 0
     try:
         for name, shape, ftype in model_tensor_plan(spec):
             nbytes = _tensor_bytes(shape, ftype)
@@ -1070,11 +1298,18 @@ def bcast_model_tensors(spec, path: str | None):
                     raise EOFError(f"model file truncated at {name}")
             else:
                 raw = np.zeros(nbytes, np.uint8)
+            t0 = time.perf_counter()
             raw = _bcast(raw)
+            total_ms += (time.perf_counter() - t0) * 1e3
+            total_bytes += nbytes
             yield tensor_from_bytes(name, shape, ftype, raw.tobytes())
     finally:
         if f is not None:
             f.close()
+        # one ledger note for the whole stream (per-tensor events would
+        # be hundreds of lines for one number an operator wants)
+        if total_bytes:
+            _note_bcast("tensors", total_ms, total_bytes)
 
 
 def broadcast_seed(seed: int) -> int:

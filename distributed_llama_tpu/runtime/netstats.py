@@ -16,6 +16,13 @@ collectives live inside one compiled program, so the equivalent here is:
 Ring-algorithm cost model: an all-reduce moves 2*(n-1)/n * payload per
 device, an all-gather / all-to-all (n-1)/n * payload (SURVEY.md §3.4 maps
 the reference's per-layer broadcast/gather pairs onto these).
+
+The MEASURED side (dlwire) lives next to the model: the multihost
+control plane's socket ledger (parallel/multihost.py → stats.WireStats)
+counts real bytes per (peer, kind, direction), :func:`per_step_op_ms`
+attributes real device collective ms per executed step from a profiler
+capture, and :func:`reconcile_wire` closes the loop — measured against
+modeled, drift flagged at ≥25% like the autotune knee check.
 """
 
 from __future__ import annotations
@@ -190,6 +197,44 @@ def estimate_prefix_reuse(
     }
 
 
+# measured-vs-modeled movement worth flagging, the same 25% bar the
+# autotune knee-drift check uses (tools/dlprof.py mirrors both — it must
+# run with no repo on the path; tests pin the mirrors against each other)
+WIRE_DRIFT_FRAC = 0.25
+
+
+def reconcile_wire(measured: float, modeled: float, *,
+                   threshold: float = WIRE_DRIFT_FRAC,
+                   unit: str = "bytes") -> dict:
+    """Measured wire traffic (the dlwire ledger) vs the model — the
+    closed loop the reference's printed T/S columns never had. Units are
+    the caller's (control-plane bytes against frame-size arithmetic;
+    per-token kB against :func:`estimate_decode_wire`) — only the RATIO
+    matters here. ``drift`` trips at ``threshold`` relative movement:
+    past it either the model is wrong (a collective the estimate does
+    not know about) or the measurement is (bytes leaking outside the
+    ledger) — both are findings. Modeled == 0 cannot reconcile: the
+    result says so instead of dividing."""
+    measured = float(measured)
+    modeled = float(modeled)
+    out = {"measured": round(measured, 4), "modeled": round(modeled, 4),
+           "unit": unit, "threshold": threshold,
+           "drift_frac": None, "drift": False, "note": None}
+    if modeled > 0:
+        frac = abs(measured - modeled) / modeled
+        out["drift_frac"] = round(frac, 4)
+        out["drift"] = frac >= threshold
+        if out["drift"]:
+            out["note"] = (f"measured {unit} moved {frac:.0%} from the "
+                           f"model (>= {threshold:.0%}): the byte model "
+                           "or the ledger is wrong — investigate before "
+                           "trusting either")
+    elif measured > 0:
+        out["note"] = ("no model to reconcile against (modeled == 0) — "
+                       "measured traffic stands alone")
+    return out
+
+
 COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                       "all-to-all", "collective-permute")
 
@@ -245,6 +290,44 @@ def per_step_op_ms(trace_dir: str, markers: tuple = COLLECTIVE_MARKERS,
                     out[i] += e.duration_ns / 1e6
         return out
     return []
+
+
+def per_trace_attribution(trace_dir: str) -> tuple[dict, float]:
+    """ONE ProfileData walk returning both halves the sampled-step
+    ingest needs: ({module name: total device ms}, total collective
+    device ms). The separate :func:`per_module_ms` /
+    :func:`per_step_op_ms` entry points each re-parse the whole xplane
+    protobuf (tens of ms to seconds on a big trace) — the per-sample
+    ingest thread must not pay that twice for one capture. Returns
+    ({}, 0.0) when the trace has no device plane (CPU runs)."""
+    import glob
+
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        return {}, 0.0
+    files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
+    if not files:
+        return {}, 0.0
+    pd = ProfileData.from_file(files[-1])
+    mods: dict[str, float] = {}
+    sync_ms = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for ln in plane.lines:
+            if ln.name == "XLA Modules":
+                for e in ln.events:
+                    name = e.name.split("(")[0]
+                    if name.startswith("jit_"):
+                        name = name[4:]
+                    mods[name] = mods.get(name, 0.0) + e.duration_ns / 1e6
+            elif ln.name in ("XLA Ops", "Async XLA Ops"):
+                for e in ln.events:
+                    if any(m in e.name for m in COLLECTIVE_MARKERS):
+                        sync_ms += e.duration_ns / 1e6
+    return ({k: round(v, 4) for k, v in mods.items()},
+            round(sync_ms, 4))
 
 
 def per_module_ms(trace_dir: str) -> dict:

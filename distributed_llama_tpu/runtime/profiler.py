@@ -567,6 +567,57 @@ class DeviceTimeStats:
         return out
 
 
+class SyncStats:
+    """Per-sampled-step device sync/compute split — the reference's
+    per-token I/T/S columns reborn for XLA (fed by
+    ``netstats.per_step_op_ms``: device time of collective ops —
+    all-reduce / all-gather / reduce-scatter / all-to-all /
+    collective-permute — bucketed per executed module, vs the module's
+    total device ms). One (sync_ms, device_ms, wall_ms) record per
+    sampled step; the summary is the ``sync`` half of the
+    ``device_time`` /stats block and the ``dllama_step_sync_ms`` /
+    ``dllama_step_sync_share`` /metrics families."""
+
+    def __init__(self, window: int = 512):
+        from collections import deque
+
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._sync = deque(maxlen=self.window)
+        self._device = deque(maxlen=self.window)
+        self._wall = deque(maxlen=self.window)
+
+    def record(self, sync_ms: float, device_ms: float,
+               wall_ms: float | None = None) -> None:
+        with self._lock:
+            self._sync.append(float(sync_ms))
+            self._device.append(float(device_ms))
+            if wall_ms is not None:
+                self._wall.append(float(wall_ms))
+
+    def summary(self) -> dict:
+        from .stats import percentile
+
+        with self._lock:
+            sync = list(self._sync)
+            dev = list(self._device)
+            wall = list(self._wall)
+        if not sync:
+            return {"n": 0}
+        rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
+        total_dev = sum(dev)
+        return {
+            "n": len(sync),
+            "sync_p50_ms": rnd(percentile(sync, 50)),
+            "sync_p99_ms": rnd(percentile(sync, 99)),
+            "device_p50_ms": rnd(percentile(dev, 50)),
+            # window-mean share, sums not means-of-ratios: a near-idle
+            # step's ratio must not swamp the loaded steps' story
+            "sync_share": rnd(sum(sync) / total_dev) if total_dev else None,
+            "wall_p50_ms": rnd(percentile(wall, 50)) if wall else None,
+        }
+
+
 class Profiler:
     """On-demand jax.profiler capture + sampled per-step device-time
     attribution (module singleton: ``PROFILER``).
@@ -590,6 +641,7 @@ class Profiler:
         self.sample_failures = 0    # start/stop/parse errors (backend-dep)
         self.captures = 0           # /admin/profile captures completed
         self.device_time = DeviceTimeStats()
+        self.sync = SyncStats()     # sampled sync/compute split (dlwire)
         self._lock = threading.Lock()
         self._busy = False          # the one process-global trace slot
 
@@ -652,14 +704,16 @@ class Profiler:
                 self._busy = False
             return None
 
-    def step_end(self, directory: str) -> None:
+    def step_end(self, directory: str, wall_ms: float | None = None) -> None:
         """Stop the step trace, then hand parse + cleanup to a short
         daemon thread: per_module_ms walks an xplane protobuf (tens of
         ms to seconds on a big trace), and the scheduler thread calling
         this must get back to serving — the sampled step's serving-side
         cost is the capture itself, never the analysis. Parse errors
         count, never raise — attribution is best-effort observability,
-        the step itself already succeeded."""
+        the step itself already succeeded. ``wall_ms`` is the sampled
+        step's host wall (rides the sync record so the report can show
+        device sync next to the step wall it lived in)."""
         import jax
 
         try:
@@ -671,17 +725,36 @@ class Profiler:
             return
         with self._lock:
             self._busy = False
-        threading.Thread(target=self._ingest, args=(directory,),
+        threading.Thread(target=self._ingest, args=(directory, wall_ms),
                          name="dlprof-ingest", daemon=True).start()
 
-    def _ingest(self, directory: str) -> None:
+    def _ingest(self, directory: str, wall_ms: float | None = None) -> None:
         import shutil
 
         try:
-            from .netstats import per_module_ms
+            from .netstats import per_trace_attribution
 
-            for name, ms in per_module_ms(directory).items():
+            # ONE xplane walk for both halves (per-module device ms AND
+            # summed collective ms) — the separate parsers would each
+            # re-read the whole protobuf per sampled step
+            per_mod, sync_ms = per_trace_attribution(directory)
+            for name, ms in per_mod.items():
                 self.device_time.record(name, ms)
+            # the sync/compute split: collective device ms over total
+            # device ms for the sampled window. The parser returns
+            # empty on traces with no device plane (CPU runs) — the
+            # split is then honestly absent, never 0%.
+            device_ms = sum(per_mod.values())
+            if per_mod:
+                self.sync.record(sync_ms, device_ms, wall_ms)
+                if TRACER.enabled:
+                    TRACER.event(
+                        "sync", 0, sync_ms=round(sync_ms, 4),
+                        device_ms=round(device_ms, 4),
+                        wall_ms=(None if wall_ms is None
+                                 else round(wall_ms, 4)),
+                        share=(round(sync_ms / device_ms, 4)
+                               if device_ms else None))
             self.sampled += 1
         except Exception:  # noqa: BLE001 — malformed/absent trace plane
             self.sample_failures += 1
@@ -694,7 +767,8 @@ class Profiler:
                 "sampled_steps": self.sampled,
                 "sample_failures": self.sample_failures,
                 "captures": self.captures,
-                "by_entry": self.device_time.summary()}
+                "by_entry": self.device_time.summary(),
+                "sync": self.sync.summary()}
 
     def reset(self) -> None:
         self.sample_every = 0
@@ -703,6 +777,7 @@ class Profiler:
         self.sample_failures = 0
         self.captures = 0
         self.device_time = DeviceTimeStats()
+        self.sync = SyncStats()
 
 
 PROFILER = Profiler()

@@ -474,9 +474,13 @@ class Scheduler:
         try:
             return self._step_body()
         finally:
+            # wall BEFORE clearing the heartbeat: the sampled step's host
+            # wall rides the sync/compute record (dlwire) so dlprof can
+            # show device collective ms against the step it lived in
+            wall_ms = (time.perf_counter() - self._step_t0) * 1e3
             self._step_t0 = None
             if prof is not None:
-                PROFILER.step_end(prof)
+                PROFILER.step_end(prof, wall_ms)
 
     def _step_body(self) -> bool:
         if not self._queue and all(s.req is None for s in self.slots):

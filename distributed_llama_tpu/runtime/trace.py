@@ -81,10 +81,19 @@ EVENT_KINDS = (
     "fault",          # an armed fault site actually fired (site, key)
     "engine_failure",  # supervisor caught a crash/stall (kind, key)
     "recovery",       # supervisor rebuilt to ready (ms, key)
-    "cluster_lost",   # ClusterPeerLost escalation
+    "cluster_lost",   # ClusterPeerLost escalation / casualty span (node,
+    #                   reason, phase — linked under the active trace id)
     "worker_exit",    # replica worker process died (replica, cls, rc)
     "respawn",        # worker respawned to routable (replica, ms)
     "step",           # scheduler iteration (timeline record)
+    "handshake",      # cluster control star formed (role, peers)
+    "cluster_tick",   # one cluster protocol frame handled (phase, rank)
+    #                   — the multihost worker's span unit
+    "bcast",          # startup data-plane broadcast timed (what, ms,
+    #                   bytes — bcast_spec / bcast_model_tensors)
+    "sync",           # sampled device sync/compute attribution: one
+    #                   sampled step's collective vs total device ms
+    #                   (runtime/profiler.py over netstats.per_step_op_ms)
     "compile",        # an executable was minted (key, ms, warm) —
     #                   runtime/profiler.CompileLedger
     "compile_after_warmup",  # the recompile sentinel fired (key, frozen)
@@ -262,6 +271,17 @@ class Tracer:
         with self._lock:
             self._next_id += 1
             return self._next_id
+
+    def reserve(self, tid: int) -> None:
+        """Adopt a REMOTELY-minted trace id: advance the local counter
+        past it so this process's own future mints can never collide.
+        Both sides of a star mint from 1, so a worker that records
+        under the root's run tids AND mints its own (its scheduler
+        door) would otherwise cross-link unrelated spans in the index
+        and ship foreign events back on export_span."""
+        with self._lock:
+            if tid > self._next_id:
+                self._next_id = int(tid)
 
     # -- recording ----------------------------------------------------------
 
@@ -567,6 +587,85 @@ def _add_device_blocks(p: _Prom, summary: dict,
                   help_="Sampled per-step device ms by entry point")
             p.add(pre + "device_samples_total", rec.get("n"), lab,
                   type_="counter")
+        sync = dev.get("sync")
+        if sync and sync.get("n"):
+            # the reference's I/T/S split reborn: per sampled step,
+            # device collective (sync) ms vs total device ms
+            p.add(pre + "step_sync_ms", sync.get("sync_p50_ms"),
+                  {**(labels or {}), "quantile": "0.5"},
+                  help_="Sampled per-step device collective ms (the "
+                        "sync half of the sync/compute split)")
+            p.add(pre + "step_sync_ms", sync.get("sync_p99_ms"),
+                  {**(labels or {}), "quantile": "0.99"})
+            p.add(pre + "step_sync_share", sync.get("sync_share"),
+                  labels,
+                  help_="Collective share of sampled device step time "
+                        "(window mean)")
+
+
+_CLUSTER_COUNTERS = (
+    ("pings_sent", "dllama_cluster_pings_sent_total"),
+    ("pongs_received", "dllama_cluster_pongs_received_total"),
+    ("pongs_sent", "dllama_cluster_pongs_sent_total"),
+    ("frames_sent", "dllama_cluster_frames_sent_total"),
+    ("frames_received", "dllama_cluster_frames_received_total"),
+    ("connect_retries", "dllama_cluster_connect_retries_total"),
+)
+
+
+def _add_cluster(p: _Prom, cluster: dict | None) -> None:
+    """The cluster-plane families (parallel/multihost ClusterStats +
+    its dlwire ledger): every counter the /stats block carries, the
+    phase label, the startup broadcast timings, and the measured wire
+    ledger — tier-invariant like every other family (the api server
+    attaches the cluster block in every tier, so a launch flag can
+    never drop these from a scrape)."""
+    if not cluster:
+        return
+    p.add("dllama_cluster_peers_lost_total",
+          len(cluster.get("peers_lost") or ()), type_="counter",
+          help_="Structured ClusterPeerLost detections")
+    for key, name in _CLUSTER_COUNTERS:
+        p.add(name, cluster.get(key), type_="counter")
+    p.add("dllama_cluster_nnodes", cluster.get("nnodes"),
+          help_="Configured cluster size")
+    ph = cluster.get("phase")
+    if ph:
+        p.add("dllama_cluster_phase", 1, {"phase": _esc(ph)},
+              help_="Current cluster phase (info-style: constant 1, "
+                    "phase in the label)")
+    p.add("dllama_cluster_bcast_ms", cluster.get("bcast_spec_ms"),
+          {"what": "spec"},
+          help_="Startup data-plane broadcast wall ms by phase")
+    p.add("dllama_cluster_bcast_ms", cluster.get("bcast_tensors_ms"),
+          {"what": "tensors"})
+    if cluster.get("bcast_tensors_bytes"):
+        p.add("dllama_cluster_bcast_bytes_total",
+              cluster.get("bcast_tensors_bytes"), {"what": "tensors"},
+              type_="counter",
+              help_="Tensor bytes streamed through the startup broadcast")
+    wire = cluster.get("wire") or {}
+    for peer, rec in (wire.get("peers") or {}).items():
+        for dirn in ("tx", "rx"):
+            for kind, kb in (rec.get(dirn) or {}).items():
+                lab = {"peer": str(peer), "kind": _esc(kind), "dir": dirn}
+                p.add("dllama_wire_bytes_total", kb.get("bytes"), lab,
+                      type_="counter",
+                      help_="Measured control-plane bytes by peer, MSG "
+                            "kind, and direction (the dlwire ledger)")
+                p.add("dllama_wire_frames_total", kb.get("frames"), lab,
+                      type_="counter",
+                      help_="Measured control-plane frames")
+        rtt = rec.get("rtt_ms") or {}
+        p.add("dllama_heartbeat_rtt_ms", rtt.get("p50_ms"),
+              {"peer": str(peer), "quantile": "0.5"},
+              help_="PING→PONG round trip per peer (window)")
+        p.add("dllama_heartbeat_rtt_ms", rtt.get("p99_ms"),
+              {"peer": str(peer), "quantile": "0.99"})
+        p.add("dllama_cluster_clock_offset_ms",
+              rec.get("clock_offset_ms"), {"peer": str(peer)},
+              help_="PING/PONG-midpoint clock-offset estimate (peer wall "
+                    "minus local wall, at the best-RTT sample)")
 
 
 def _add_admission(p: _Prom, adm: dict | None, *,
@@ -701,15 +800,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                       proc.get("respawn_p50_ms"),
                       {**lab, "quantile": "0.5"},
                       help_="Death-detected to routable-again latency")
-        cluster = summary.get("cluster")
-        if cluster:
-            p.add("dllama_cluster_peers_lost_total",
-                  len(cluster.get("peers_lost") or ()), type_="counter",
-                  help_="Structured ClusterPeerLost detections")
-            p.add("dllama_cluster_pings_sent_total",
-                  cluster.get("pings_sent"), type_="counter")
-            p.add("dllama_cluster_pongs_received_total",
-                  cluster.get("pongs_received"), type_="counter")
+        _add_cluster(p, summary.get("cluster"))
     if tracer is not None and tracer.enabled:
         t = tracer.summary()
         p.add("dllama_trace_events", t["events"],

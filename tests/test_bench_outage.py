@@ -360,6 +360,23 @@ def test_chaos_row_emits_valid_json():
     assert cl["within_bound"] is True, cl
     assert cl["value"] / 1e3 < cl["worker_timeout_s"], cl
     assert cl["stall_reason"] == "timeout", cl
+    # dlwire (ISSUE 12): the cluster row's wire block is POPULATED — a
+    # clean run's measured ledger from both ends, nonzero per-peer
+    # bytes, heartbeat RTT, and the exact frame-arithmetic
+    # reconciliation (drift 0.0 by construction)
+    wire = cl["wire"]
+    root_peer = wire["root"]["peers"]["1"]
+    assert root_peer["tx"]["PING"]["bytes"] > 0, wire
+    assert root_peer["rx"]["PONG"]["frames"] >= 1, wire
+    assert root_peer["rtt_ms"]["n"] >= 1, wire
+    assert wire["worker"]["peers"]["0"]["rx"]["RUN"]["bytes"] > 0, wire
+    rec = wire["reconcile"]
+    assert rec["drift_frac"] == 0.0 and rec["drift"] is False, rec
+    assert rec["measured"] == rec["modeled"] > 0, rec
+    # and the step_timeline is no longer empty-by-construction: the
+    # control plane's "step" is one heartbeat round trip
+    tl = cl["step_timeline"]
+    assert tl.get("dec0_pre0_c0", {}).get("n", 0) >= 1, tl
     json.dumps(cl)  # machine-readable round-trip
     assert c["unit"] == "%" and 0.0 <= c["value"] <= 100.0
     assert c["requests"] == 4 and c["crashes_injected"] >= 1

@@ -275,7 +275,317 @@ def test_hello_version_mismatch_is_symmetric_error():
         assert "version" in failed["error"], failed
 
 
+# -- dlwire: measured wire ledger + cross-node trace (ISSUE 12) ------------
+
+
+def test_cross_node_trace_spans_link_under_one_id():
+    """A traced two-process run: the root mints ONE trace id, phase
+    frames carry it, the worker's cluster_tick spans ship back via
+    MSG_TRACE and land on the root's timeline (origin=node1) under the
+    SAME id as the root's own events — the cross-node acceptance bar."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,decode:0.4",
+                  "--trace")
+    worker = _spawn("worker", port, "--trace")
+    w_out, w_err = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert root.returncode == 0, (r_out, r_err)
+    assert worker.returncode == 0, (w_out, w_err)
+    r_ev = _events(r_out)
+    tid = _event(r_ev, "complete")["tid"]
+    assert tid > 0
+    dump = _event(r_ev, "trace_dump")
+    assert dump["tid"] == tid
+    evs = dump["events"]
+    assert all(e["tid"] == tid for e in evs if e.get("tid")), evs
+    root_ticks = [e for e in evs if e["kind"] == "cluster_tick"
+                  and "origin" not in e]
+    worker_ticks = [e for e in evs if e["kind"] == "cluster_tick"
+                    and e.get("origin") == "node1"]
+    assert root_ticks and worker_ticks, evs
+    assert {e["phase"] for e in worker_ticks} <= {e["phase"]
+                                                  for e in root_ticks}
+    # every dumped event is wall-stamped (the /admin/trace export shape)
+    assert all("ts_wall" in e for e in evs), evs
+    # the clean run has no casualty span
+    assert not [e for e in evs if e["kind"] == "cluster_lost"], evs
+
+
+def test_peer_close_death_yields_linked_casualty_span():
+    """peer_close tears the worker down at a protocol send (its PONG):
+    the root's bounded detection must emit a cluster_lost CASUALTY event
+    linked under the session's trace id — on the same timeline as the
+    worker's earlier shipped ticks — before its diagnostic exit. The
+    cluster twin of a SIGKILLed replica's worker_exit span."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,decode:20",
+                  "--trace")
+    # after=2: HELLO + one frame pass, then the next send (a PONG) fires
+    worker = _spawn("worker", port, "--trace",
+                    faults="peer_close:after=2;times=1")
+    try:
+        r_out, r_err = _finish(root, 30)
+        assert root.returncode == EXIT_PEER_LOST, (r_out, r_err)
+        r_ev = _events(r_out)
+        lost = _event(r_ev, "cluster_peer_lost")
+        assert lost["node_id"] == 1
+        dump = _event(r_ev, "trace_dump")
+        tid = dump["tid"]
+        assert tid > 0
+        casualty = [e for e in dump["events"]
+                    if e["kind"] == "cluster_lost"]
+        assert casualty, dump["events"]
+        assert casualty[0]["tid"] == tid
+        assert casualty[0]["node"] == 1
+        assert casualty[0]["reason"] == lost["reason"]
+        # linked: the same id also carries the root's own protocol ticks
+        assert [e for e in dump["events"]
+                if e["kind"] == "cluster_tick" and e["tid"] == tid]
+    finally:
+        worker.kill()
+        worker.communicate(timeout=10)
+
+
+def test_wire_ledger_counts_match_frame_arithmetic_exactly():
+    """The measured-bytes acceptance bar: after a clean harness run,
+    every deterministic protocol frame's ledger count equals
+    frame_bytes() arithmetic EXACTLY — on both ends of the star
+    (root tx == worker rx for RUN/SHUTDOWN; PONG bytes likewise)."""
+    from distributed_llama_tpu.parallel.multihost import (_HEADER_LEN,
+                                                          frame_bytes)
+
+    port = _free_port()
+    phases = [("formation", 0.1), ("prefill", 0.3), ("decode", 0.3)]
+    root = _spawn("root", port, "--phases",
+                  ",".join(f"{n}:{s}" for n, s in phases))
+    worker = _spawn("worker", port)
+    w_out, w_err = _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert root.returncode == 0, (r_out, r_err)
+    assert worker.returncode == 0, (w_out, w_err)
+    root_wire = _event(_events(r_out), "complete")["stats"]["wire"]
+    worker_wire = _event(_events(w_out), "shutdown")["stats"]["wire"]
+    rtx = root_wire["peers"]["1"]["tx"]
+    wrx = worker_wire["peers"]["0"]["rx"]
+
+    run_expected = sum(frame_bytes(_HEADER_LEN, len(n.encode()))
+                       for n, _ in phases)
+    assert rtx["RUN"]["bytes"] == run_expected, (rtx, run_expected)
+    assert wrx["RUN"]["bytes"] == run_expected
+    assert rtx["RUN"]["frames"] == len(phases) == wrx["RUN"]["frames"]
+    shut_expected = frame_bytes(_HEADER_LEN, 0)
+    assert rtx["SHUTDOWN"]["bytes"] == shut_expected
+    assert wrx["SHUTDOWN"]["bytes"] == shut_expected
+    # heartbeat traffic: counts are timing-dependent but the SHAPE is
+    # exact — every PING is frame_bytes(1, 0), every PONG frame_bytes(2,
+    # 0) (seq + worker wall clock)
+    ping = rtx["PING"]
+    assert ping["bytes"] == ping["frames"] * frame_bytes(1, 0), ping
+    pong = root_wire["peers"]["1"]["rx"]["PONG"]
+    assert pong["bytes"] == pong["frames"] * frame_bytes(2, 0), pong
+    # and both ends agree on the heartbeat bytes that actually crossed
+    assert pong["bytes"] == worker_wire["peers"]["0"]["tx"]["PONG"]["bytes"]
+
+
+def test_heartbeat_rtt_and_clock_offset_measured():
+    """PING→PONG round trips land in the per-peer RTT histogram and the
+    midpoint clock-offset estimate exists (≈0 between processes on one
+    host — the bound here is loose on purpose, the ESTIMATE is what the
+    MSG_TRACE rebase consumes)."""
+    port = _free_port()
+    root = _spawn("root", port, "--phases", "formation:0.1,idle:0.6")
+    worker = _spawn("worker", port)
+    _finish(worker, 30)
+    r_out, r_err = _finish(root, 30)
+    assert root.returncode == 0, (r_out, r_err)
+    peer = _event(_events(r_out), "complete")["stats"]["wire"]["peers"]["1"]
+    rtt = peer["rtt_ms"]
+    assert rtt["n"] >= 1 and rtt["p50_ms"] >= 0, rtt
+    assert rtt["p99_ms"] >= rtt["p50_ms"]
+    assert len(rtt["recent"]) == rtt["n"] or len(rtt["recent"]) == 32
+    assert abs(peer["clock_offset_ms"]) < 1000.0, peer
+    assert peer["best_rtt_ms"] <= rtt["p99_ms"] + 1e-9
+
+
 # -- in-process shape/codec tests (no subprocess) --------------------------
+
+
+def _acct_recorder():
+    calls = []
+    return calls, lambda kind, n: calls.append((kind, n))
+
+
+def test_torn_send_counts_partial_bytes_exactly_once():
+    """frame_truncate writes half the frame then closes: the ledger hook
+    must see exactly those partial bytes, once — and peer_close (closes
+    without writing) must count zero. The PR-5 fault sites are the
+    torn-frame truth the wire counters must survive."""
+    from distributed_llama_tpu.parallel.multihost import (
+        ClusterProtocolError, _send_frame, frame_bytes)
+    from distributed_llama_tpu.runtime.faults import FAULTS
+
+    a, b = socket.socketpair()
+    calls, acct = _acct_recorder()
+    try:
+        FAULTS.arm("frame_truncate", times=1)
+        buf_len = frame_bytes(3, 7)
+        with pytest.raises(ClusterProtocolError, match="frame_truncate"):
+            _send_frame(a, 1, [1, 2, 3], b"payload", timeout=5.0,
+                        acct=acct)
+        assert calls == [(1, max(1, buf_len // 2))], calls
+
+        calls.clear()
+        c, d = socket.socketpair()
+        try:
+            FAULTS.arm("peer_close", times=1)
+            with pytest.raises(ClusterProtocolError, match="peer_close"):
+                _send_frame(c, 1, [], b"x", timeout=5.0, acct=acct)
+            assert calls == [], calls  # zero bytes crossed: no entry
+        finally:
+            d.close()
+    finally:
+        FAULTS.clear()
+        a.close()
+        b.close()
+
+
+def test_torn_recv_counts_partial_bytes_exactly_once():
+    """A frame torn mid-payload (EOF after the header): the receiving
+    ledger counts the bytes that actually arrived, once, under the
+    parsed kind — and a successful recv counts the exact frame size."""
+    import struct
+
+    from distributed_llama_tpu.parallel.multihost import (
+        _FRAME_HDR, _FRAME_MAGIC, ClusterProtocolError, _recv_frame,
+        _send_frame, frame_bytes)
+
+    a, b = socket.socketpair()
+    calls, acct = _acct_recorder()
+    try:
+        # clean frame: exact arithmetic
+        _send_frame(a, 7, [1, -2], b"pay", timeout=5.0)
+        _recv_frame(b, timeout=5.0, acct=acct)
+        assert calls == [(7, frame_bytes(2, 3))], calls
+
+        # torn frame: header + one of two ints, then EOF
+        calls.clear()
+        buf = _FRAME_HDR.pack(_FRAME_MAGIC, 9, 2, 0) + struct.pack("<q", 5)
+        a.sendall(buf)
+        a.close()
+        with pytest.raises(ClusterProtocolError, match="truncated"):
+            _recv_frame(b, timeout=5.0, acct=acct)
+        assert calls == [(9, len(buf))], calls
+    finally:
+        b.close()
+
+
+def test_recv_stall_fault_counts_nothing():
+    """recv_stall wedges the reader BEFORE any bytes move: when the
+    stall releases into a closed socket, the ledger must show zero for
+    the attempt (nothing crossed the wire)."""
+    from distributed_llama_tpu.parallel.multihost import _recv_frame
+    from distributed_llama_tpu.runtime.faults import FAULTS
+
+    a, b = socket.socketpair()
+    calls, acct = _acct_recorder()
+    try:
+        FAULTS.arm("recv_stall", times=1, ms=50)
+        a.close()  # EOF once the stall releases
+        out = _recv_frame(b, timeout=5.0, acct=acct)
+        assert out is None  # clean EOF at the frame boundary
+        assert calls == [], calls
+    finally:
+        FAULTS.clear()
+        b.close()
+
+
+def test_wire_acct_disabled_path_is_allocation_free():
+    """The cost bar (PR-8 discipline): a link's accounting closure with
+    no stats object (the pre-formation / off-cluster shape) must be a
+    no-op — no allocation over 10k calls — and the codec's acct=None
+    default costs nothing."""
+    import gc
+    import sys as _sys
+
+    from distributed_llama_tpu.parallel import multihost as mh
+
+    link = mh.WorkerLink("127.0.0.1", 1, 1, 2)
+    assert link.stats is None
+    acct = link._mk_acct(0, "rx")
+    acct(mh.MSG_PING, 24)  # warm the closure path
+    gc.collect()
+    before = _sys.getallocatedblocks()
+    for _ in range(10_000):
+        acct(mh.MSG_PING, 24)
+    grew = _sys.getallocatedblocks() - before
+    assert grew < 50, f"disabled wire acct allocated {grew} blocks"
+
+
+def test_wire_ledger_enabled_cost_is_negligible():
+    """Enabled-ledger cost bar: one account() call is bounded well under
+    2% of even the tiny decode step (~5 ms on CPU-tiny; a control-plane
+    frame is heartbeat-cadence anyway, never per-token). Measured
+    loosely (CI boxes jitter): 10k accounts in well under a second."""
+    from distributed_llama_tpu.runtime.stats import WireStats
+
+    w = WireStats()
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        w.account(1, "PING", "tx", 24)
+    per_call_us = (time.perf_counter() - t0) / 10_000 * 1e6
+    # 100 µs/call would still be <2% of a decode step at heartbeat
+    # cadence; typical is <2 µs — the bar catches accidental O(n) work
+    assert per_call_us < 100, per_call_us
+    s = w.summary()
+    assert s["peers"]["1"]["tx"]["PING"] == {"frames": 10_000,
+                                             "bytes": 240_000}
+
+
+def test_wire_ledger_bounded_keys():
+    """Label-cardinality bound: past max_keys distinct kinds per
+    (peer, dir) the ledger counts overflow instead of growing."""
+    from distributed_llama_tpu.runtime.stats import WireStats
+
+    w = WireStats(max_keys=4)
+    for i in range(10):
+        w.account(1, f"K{i}", "tx", 8)
+    s = w.summary()
+    assert len(s["peers"]["1"]["tx"]) == 4
+    assert s["key_overflow"] == 6
+
+
+def test_reconcile_wire_drift_math_golden():
+    """Pinned drift math (the 25% bar shared with dlprof's mirror):
+    exact match -> 0.0/clean, 25% -> flagged (inclusive), modeled=0 ->
+    no division, honest note."""
+    from distributed_llama_tpu.runtime.netstats import (WIRE_DRIFT_FRAC,
+                                                        reconcile_wire)
+
+    r = reconcile_wire(400.0, 400.0)
+    assert r["drift_frac"] == 0.0 and r["drift"] is False
+
+    r = reconcile_wire(300.0, 400.0)  # exactly at the bar: flags
+    assert r["drift_frac"] == 0.25 and r["drift"] is True
+    assert "25%" in r["note"]
+
+    r = reconcile_wire(390.0, 400.0)
+    assert r["drift_frac"] == 0.025 and r["drift"] is False
+    assert r["note"] is None
+
+    r = reconcile_wire(100.0, 0.0)
+    assert r["drift_frac"] is None and r["drift"] is False
+    assert "no model" in r["note"]
+
+    # the dlprof mirror cannot drift from the canonical threshold
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import dlprof
+        assert dlprof.WIRE_DRIFT_FRAC == WIRE_DRIFT_FRAC
+    finally:
+        sys.path.pop(0)
+
+
+# -- in-process shape/codec tests (no subprocess, pre-dlwire) --------------
 
 
 def test_cluster_peer_lost_shape():

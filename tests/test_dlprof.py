@@ -122,6 +122,72 @@ def test_goodput_splits_on_slo():
     assert g["within_slo"] == 0  # ttft 30 ms misses a 10 ms SLO
 
 
+# -- the wire report (dlwire) -----------------------------------------------
+
+
+def test_wire_report_merges_ledgers_sync_and_reconciles():
+    """wire_report: bench rows' wire blocks (both the {root,worker}
+    bench-row shape and a raw WireStats summary) merge into per-peer
+    totals; `sync` trace events yield the window-sum share; every
+    reconcile entry is collected with the drift flag re-derived at the
+    25% bar."""
+    row = {"wire": {
+        "root": {"peers": {"1": {
+            "tx": {"PING": {"frames": 4, "bytes": 96},
+                   "RUN": {"frames": 1, "bytes": 120}},
+            "rx": {"PONG": {"frames": 4, "bytes": 128}},
+            "rtt_ms": {"n": 4, "p50_ms": 1.0, "p99_ms": 2.0,
+                       "mean_ms": 1.2},
+            "clock_offset_ms": 0.1}}},
+        "worker": {"peers": {"0": {
+            "rx": {"PING": {"frames": 4, "bytes": 96}}}}},
+        "reconcile": {"measured": 120.0, "modeled": 120.0,
+                      "unit": "bytes", "drift_frac": 0.0}}}
+    raw = {"wire": {"peers": {"2": {
+        "tx": {"RUN": {"frames": 1, "bytes": 50}}}}}}
+    sync_events = [{"kind": "sync", "tid": 0, "ts_wall": 1.0 + i,
+                    "sync_ms": 1.0, "device_ms": 4.0} for i in range(3)]
+    w = dlprof.wire_report(sync_events, [row, raw])
+    assert w["peers"]["root:peer1"]["tx_bytes"] == 216
+    assert w["peers"]["root:peer1"]["rtt_ms"]["p99_ms"] == 2.0
+    assert w["peers"]["worker:peer0"]["rx_bytes"] == 96
+    assert w["peers"]["peer2"]["tx_bytes"] == 50
+    assert w["sync"] == {"sampled_steps": 3, "sync_p50_ms": 1.0,
+                         "sync_p99_ms": 1.0, "device_p50_ms": 4.0,
+                         "sync_share": 0.25}
+    assert len(w["reconcile"]) == 1 and not w["drift"]
+
+    # a stale artifact whose producer never flagged: the report
+    # re-derives drift at its own bar (0.3 >= 0.25 -> flagged)
+    stale = {"wire": {"reconcile": {"measured": 130.0, "modeled": 100.0,
+                                    "drift_frac": 0.3}}}
+    w2 = dlprof.wire_report([], [stale])
+    assert w2["drift"] and w2["reconcile"][0]["drift"] is True
+
+    # no wire data anywhere: the section is honestly absent
+    assert dlprof.wire_report([], [{"metric": "x"}]) is None
+    r = dlprof.analyze([], [{"metric": "x"}], wire=True)
+    assert r["wire"] is None and "Wire" not in dlprof.render_markdown(r)
+
+
+def test_wire_markdown_renders_peer_table_and_flags():
+    row = {"wire": {"peers": {"1": {
+        "tx": {"RUN": {"frames": 2, "bytes": 250}},
+        "rtt_ms": {"n": 5, "p50_ms": 0.9, "p99_ms": 1.8, "mean_ms": 1.1},
+        "clock_offset_ms": 0.07}},
+        "reconcile": {"measured": 140.0, "modeled": 100.0,
+                      "unit": "bytes", "drift_frac": 0.4}}}
+    report = dlprof.analyze(
+        [{"kind": "sync", "tid": 0, "ts_wall": 1.0, "sync_ms": 2.0,
+          "device_ms": 10.0}], [row], wire=True)
+    md = dlprof.render_markdown(report)
+    assert "## Wire (measured cluster plane)" in md
+    assert "| peer1 | 250 |" in md
+    assert "0.9/1.8" in md
+    assert "share 0.2" in md
+    assert "DRIFTED" in md
+
+
 # -- end to end over a REAL scheduler trace ---------------------------------
 
 

@@ -47,6 +47,46 @@ def test_wire_estimate_components():
         LLAMA3_8B, make_mesh(tp=1, dp=8)).sent_kb_per_token == 0
 
 
+def test_reconcile_wire_golden_on_synthetic_ledger():
+    """Measured-vs-modeled reconciliation (dlwire), pinned on a synthetic
+    wire ledger: the measured control-plane bytes of a known frame
+    sequence against frame-size arithmetic (exact -> drift 0.0), a
+    doctored model (flagged at the 25% bar, inclusive), and the modeled
+    q80 decode wire as the data-plane example."""
+    from distributed_llama_tpu.parallel.multihost import (_HEADER_LEN,
+                                                          frame_bytes)
+    from distributed_llama_tpu.runtime.netstats import reconcile_wire
+    from distributed_llama_tpu.runtime.stats import WireStats
+
+    # synthetic ledger: 3 RUN frames with 4/0/9-byte payloads + 5 PINGs
+    w = WireStats()
+    for n_pay in (4, 0, 9):
+        w.account(1, "RUN", "tx", frame_bytes(_HEADER_LEN, n_pay))
+    for _ in range(5):
+        w.account(1, "PING", "tx", frame_bytes(1, 0))
+    measured = w.peer_bytes(1, "RUN", "tx")
+    modeled = sum(frame_bytes(_HEADER_LEN, n) for n in (4, 0, 9))
+    r = reconcile_wire(measured, modeled)
+    assert r["drift_frac"] == 0.0 and r["drift"] is False and \
+        r["note"] is None, r
+    assert r["measured"] == r["modeled"] == measured
+
+    # drift math pinned: 0.25 is INCLUSIVE (the flag bar), just under is
+    # clean, and the asymmetric direction measures against the MODEL
+    assert reconcile_wire(75.0, 100.0)["drift"] is True
+    assert reconcile_wire(75.0, 100.0)["drift_frac"] == 0.25
+    assert reconcile_wire(124.9, 100.0)["drift_frac"] == 0.249
+    assert reconcile_wire(124.9, 100.0)["drift"] is False
+    assert reconcile_wire(200.0, 100.0)["drift_frac"] == 1.0
+
+    # data-plane shape: the modeled q80 decode wire reconciles with
+    # itself (the silicon MULTICHIP rows will feed the measured side)
+    mesh = make_mesh(tp=2)
+    kb = estimate_decode_wire(LLAMA3_8B, mesh, q80=True).sent_kb_per_token
+    r = reconcile_wire(kb, kb, unit="kb/token")
+    assert r["drift"] is False and r["unit"] == "kb/token"
+
+
 def test_measured_allreduce_runs():
     mesh = make_mesh(tp=4)
     ms = measure_allreduce_ms(mesh, 4096, iters=4)

@@ -372,6 +372,52 @@ def test_sampled_steps_feed_device_time_without_breaking_serving(tiny):
     json.dumps(s)
 
 
+def test_sync_stats_split_and_summary():
+    """dlwire sync/compute attribution: SyncStats records one
+    (collective ms, device ms, step wall ms) triple per sampled step;
+    the share is window-sums (an idle step's ratio must not swamp the
+    loaded ones), percentiles are nearest-rank, and an empty window
+    reports n=0 with no invented numbers."""
+    from distributed_llama_tpu.runtime.profiler import SyncStats
+
+    s = SyncStats()
+    assert s.summary() == {"n": 0}
+    # three sampled steps: 25% / 50% / 0% collective
+    s.record(2.0, 8.0, 9.0)
+    s.record(4.0, 8.0, 9.5)
+    s.record(0.0, 4.0)
+    out = s.summary()
+    assert out["n"] == 3
+    assert out["sync_p50_ms"] == 2.0
+    assert out["device_p50_ms"] == 8.0
+    assert out["sync_share"] == round(6.0 / 20.0, 4)
+    assert out["wall_p50_ms"] == 9.0  # 2 wall samples: nearest-rank
+    # p50 rounds to the LOWER observed value (stats.percentile, no
+    # interpolation — round(0.5) banker's-rounds to 0)
+    json.dumps(out)
+
+    # bounded window: old samples roll off
+    s2 = SyncStats(window=4)
+    for i in range(10):
+        s2.record(1.0, 2.0, 3.0)
+    assert s2.summary()["n"] == 4
+
+
+def test_profiler_summary_carries_sync_block():
+    """The `sync` half rides the device_time /stats block (and from
+    there the dllama_step_sync_* /metrics families) in every state —
+    empty (n=0) until a sampled step lands on a backend with a device
+    plane."""
+    s = PROFILER.summary()
+    assert s["sync"] == {"n": 0}
+    PROFILER.sync.record(1.5, 6.0, 7.0)
+    s = PROFILER.summary()
+    assert s["sync"]["n"] == 1 and s["sync"]["sync_share"] == 0.25
+    json.dumps(s)
+    PROFILER.reset()
+    assert PROFILER.summary()["sync"] == {"n": 0}
+
+
 def test_capture_writes_a_trace_and_refuses_concurrent(tmp_path):
     d = str(tmp_path / "cap")
     out = PROFILER.capture(d, ms=20)

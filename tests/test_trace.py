@@ -444,6 +444,103 @@ def test_render_prometheus_router_shape_valid():
     assert m["dllama_cluster_peers_lost_total"] == [(None, 1.0)]
 
 
+def test_render_prometheus_cluster_wire_and_sync_families():
+    """dlwire (ISSUE 12): the FULL ClusterStats counter set renders as
+    tier-invariant dllama_cluster_* families (the old renderer exported
+    only 3 of them), the measured wire ledger as
+    dllama_wire_{bytes,frames}_total{peer,kind,dir} +
+    dllama_heartbeat_rtt_ms{peer} + the clock offset, the startup
+    broadcast timings, and the sampled sync/compute split as
+    dllama_step_sync_ms / dllama_step_sync_share."""
+    summary = {
+        "requests_submitted": 1, "state": "ready",
+        "cluster": {
+            "nnodes": 2, "phase": "decode", "connect_retries": 3,
+            "pings_sent": 7, "pongs_received": 6, "pongs_sent": 0,
+            "frames_sent": 9, "frames_received": 15,
+            "bcast_spec_ms": 12.5, "bcast_tensors_ms": 830.0,
+            "bcast_tensors_bytes": 1 << 20,
+            "peers_lost": [],
+            "wire": {"peers": {"1": {
+                "tx": {"PING": {"frames": 7, "bytes": 168},
+                       "RUN": {"frames": 2, "bytes": 250}},
+                "rx": {"PONG": {"frames": 6, "bytes": 192}},
+                "rtt_ms": {"n": 6, "p50_ms": 0.9, "p99_ms": 1.8,
+                           "mean_ms": 1.1, "recent": [0.9]},
+                "clock_offset_ms": 0.07, "best_rtt_ms": 0.7}}},
+        },
+        "device_time": {
+            "sample_every": 4, "sampled_steps": 3,
+            "by_entry": {"slot_decode_step": {"n": 3, "p50_ms": 2.0,
+                                              "mean_ms": 2.1}},
+            "sync": {"n": 3, "sync_p50_ms": 0.5, "sync_p99_ms": 0.8,
+                     "device_p50_ms": 2.0, "sync_share": 0.25},
+        },
+    }
+    m = _parse_prometheus(render_prometheus(summary, model="tiny"))
+    # the tier-invariant cluster counter set (satellite: a tier must not
+    # lose a family to a launch flag — these were /stats-only before)
+    assert m["dllama_cluster_pings_sent_total"] == [(None, 7.0)]
+    assert m["dllama_cluster_pongs_received_total"] == [(None, 6.0)]
+    assert m["dllama_cluster_pongs_sent_total"] == [(None, 0.0)]
+    assert m["dllama_cluster_frames_sent_total"] == [(None, 9.0)]
+    assert m["dllama_cluster_frames_received_total"] == [(None, 15.0)]
+    assert m["dllama_cluster_connect_retries_total"] == [(None, 3.0)]
+    assert m["dllama_cluster_peers_lost_total"] == [(None, 0.0)]
+    assert m["dllama_cluster_nnodes"] == [(None, 2.0)]
+    assert m["dllama_cluster_phase"] == [('phase="decode"', 1.0)]
+    assert dict(m["dllama_cluster_bcast_ms"]) == {'what="spec"': 12.5,
+                                                  'what="tensors"': 830.0}
+    assert m["dllama_cluster_bcast_bytes_total"] == [('what="tensors"',
+                                                      float(1 << 20))]
+    # the wire ledger families
+    wire = dict(m["dllama_wire_bytes_total"])
+    assert wire['peer="1",kind="PING",dir="tx"'] == 168.0
+    assert wire['peer="1",kind="RUN",dir="tx"'] == 250.0
+    assert wire['peer="1",kind="PONG",dir="rx"'] == 192.0
+    frames = dict(m["dllama_wire_frames_total"])
+    assert frames['peer="1",kind="PING",dir="tx"'] == 7.0
+    rtt = dict(m["dllama_heartbeat_rtt_ms"])
+    assert rtt['peer="1",quantile="0.5"'] == 0.9
+    assert rtt['peer="1",quantile="0.99"'] == 1.8
+    assert m["dllama_cluster_clock_offset_ms"] == [('peer="1"', 0.07)]
+    # the sync/compute split (the reference's I/T/S reborn)
+    sync = dict(m["dllama_step_sync_ms"])
+    assert sync['quantile="0.5"'] == 0.5 and sync['quantile="0.99"'] == 0.8
+    assert m["dllama_step_sync_share"] == [(None, 0.25)]
+
+
+def test_ingest_rebases_cluster_node_spans_onto_one_timeline():
+    """A multihost worker's MSG_TRACE span (wall-stamped, shifted by the
+    clock-offset estimate at the link layer) merges under the SAME trace
+    id as the root's events — by_id serves the linked span the way
+    /admin/trace?id= would."""
+    TRACER.configure(capacity=256)
+    tid = TRACER.new_id()
+    TRACER.event("cluster_tick", tid, phase="run", role="root", rank=0)
+    # a worker span as multihost._ingest_trace hands it over: ts_wall
+    # stamps in the (already offset-corrected) local wall domain
+    now_wall = TRACER.to_wall(__import__("time").perf_counter())
+    TRACER.ingest([
+        {"ts_wall": now_wall + 0.001, "kind": "cluster_tick", "tid": tid,
+         "phase": "run", "role": "worker", "rank": 1},
+        {"ts_wall": now_wall + 0.050, "kind": "cluster_tick", "tid": tid,
+         "phase": "run_done", "role": "worker", "rank": 1, "ms": 49.0},
+    ], origin="node1")
+    TRACER.event("cluster_lost", tid, node=1, reason="eof", phase="run")
+    span = TRACER.by_id(tid)
+    assert [e["kind"] for e in span] == ["cluster_tick", "cluster_tick",
+                                        "cluster_tick", "cluster_lost"]
+    origins = [e.get("origin") for e in span]
+    assert origins == [None, "node1", "node1", None]
+    # the ingested pair rebased into the LOCAL monotonic domain with
+    # their relative spacing preserved (49 ms apart, near "now")
+    w0, w1 = span[1]["ts"], span[2]["ts"]
+    assert abs((w1 - w0) - 0.049) < 1e-6, (w0, w1)
+    local_now = span[3]["ts"]
+    assert abs(w0 - local_now) < 1.0, (w0, local_now)
+
+
 def test_render_prometheus_handles_none_and_idle():
     # legacy / unbuilt tiers: still a valid, scrapeable document
     for mode, st in (("legacy", "off"), ("scheduler", "idle")):
@@ -593,6 +690,42 @@ def test_metrics_and_trace_endpoints_all_tiers(api_state, tiny):
         srv.shutdown()
         if state._scheduler is not None:
             state._scheduler.close()
+
+
+def test_stats_and_metrics_carry_wire_plane_with_live_link(api_state):
+    """With a cluster link installed, /stats hoists the measured wire
+    ledger as its own `wire` block and /metrics renders the
+    dllama_cluster_* + dllama_wire_* families — in the LEGACY tier too
+    (tier-invariance satellite: the cluster plane must not vanish off a
+    launch flag)."""
+    from distributed_llama_tpu.parallel import multihost as mh
+
+    link = mh.WorkerLink("127.0.0.1", 1, 1, 2)
+    link._init_stats(connect_retries=2)
+    link.stats.pongs_sent = 5
+    link.stats.wire.account(0, "PING", "rx", 160, frames=5)
+    link.stats.wire.account(0, "PONG", "tx", 160, frames=5)
+    old = mh.get_link()
+    mh.set_link(link)
+    state = api_state()  # legacy tier: serve_batch off
+    srv = _serve(state)
+    try:
+        code, _, body = _get(srv.server_address, "/stats")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["cluster"]["pongs_sent"] == 5
+        wire = payload["wire"]  # the hoisted block
+        assert wire["peers"]["0"]["tx"]["PONG"]["bytes"] == 160
+        assert wire["rx_bytes"] == 160
+
+        m = _parse_prometheus(_get(srv.server_address, "/metrics")[2])
+        assert m["dllama_cluster_pongs_sent_total"] == [(None, 5.0)]
+        assert m["dllama_cluster_connect_retries_total"] == [(None, 2.0)]
+        assert dict(m["dllama_wire_bytes_total"])[
+            'peer="0",kind="PONG",dir="tx"'] == 160.0
+    finally:
+        srv.shutdown()
+        mh.set_link(old)
 
 
 def test_admin_trace_404_when_tracing_off(api_state):
