@@ -174,6 +174,52 @@ def entry_points(max_devices: int | None = None,
         {"activation_elems": mb_x * bl_x * spec_x.n_kv_heads
          * spec_x.head_size, "dim": spec_x.dim}))
 
+    # -- speculative-decoding serving executables (runtime/draft.py) ------
+    # draft_forward: the k-step greedy draft scan (truncated-depth spec —
+    # n_layers 1 of the tiny 2 mirrors the self-draft slice). Traced
+    # through the SAME module-level body the engine jits
+    # (draft.draft_scan_tokens), so the pinned fingerprint covers the
+    # real per-slot draft path; a drifting pos dtype here would retrace
+    # per proposal and stall every speculative iteration.
+    from ..runtime.draft import batched_verify, draft_scan_tokens
+
+    import dataclasses as _dc
+
+    spec_d = _dc.replace(_tiny_spec(), n_layers=1)
+    params_d = _zero_params(spec_d)
+    from ..models.transformer import KVCache as _KVC
+
+    cache_d = _KVC.create(spec_d, batch=4, seq_len=spec_d.seq_len,
+                          dtype=jnp.float32)
+    tok_d = jnp.zeros((4, 1), jnp.int32)
+    pos_d = jnp.zeros((4,), jnp.int32)
+
+    def draft_forward(params, tok0, pos, cache):
+        return draft_scan_tokens(params, spec_d, tok0, pos, cache, k=2,
+                                 n_vocab=spec_d.vocab_size,
+                                 fwd_kwargs=dict(
+                                     compute_dtype=jnp.float32))
+
+    out.append(EntryPoint(
+        "draft_forward", draft_forward, (params_d, tok_d, pos_d, cache_d),
+        {"activation_elems": 4 * 1 * spec_d.dim, "dim": spec_d.dim}))
+
+    # slot_verify: the fixed-width (B, 1+K) verify forward with on-device
+    # argmax — the scheduler's one speculative target executable
+    # (Engine.slot_verify_step jits the same draft.batched_verify body)
+    spec_v, params_v, tok_v, _, cache_v = build_forward_inputs(batch=4,
+                                                               t=3)
+    pos_v = jnp.zeros((4,), jnp.int32)
+
+    def slot_verify(params, tok, pos, cache):
+        return batched_verify(params, spec_v, tok, pos, cache,
+                              n_vocab=spec_v.vocab_size,
+                              fwd_kwargs=dict(compute_dtype=jnp.float32))
+
+    out.append(EntryPoint(
+        "slot_verify", slot_verify, (params_v, tok_v, pos_v, cache_v),
+        {"activation_elems": 4 * 3 * spec_v.dim, "dim": spec_v.dim}))
+
     if n_dev >= 2:
         from ..parallel import make_mesh
         from ..parallel.tp_q80 import tp_col_matmul, tp_row_matmul

@@ -115,7 +115,8 @@ class ApiState:
                  profile_dir: str | None = None,
                  slo_ttft_ms: float | None = None,
                  slo_itl_ms: float | None = None,
-                 autosize: dict | None = None):
+                 autosize: dict | None = None,
+                 draft: str | None = None, draft_len: int = 0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -135,6 +136,22 @@ class ApiState:
         # greedy requests draft+verify up to this many tokens per forward
         # (prompt-lookup speculation, runtime/speculative.py); 0 = off
         self.lookup_decode = lookup_decode
+        # REAL-draft speculation (runtime/draft.py): the --draft spec
+        # string ("self:D" / "model:PATH") and per-forward budget. On
+        # the scheduler path the draft rides build_front_door into
+        # every replica's scheduler; on the legacy path a DraftModel is
+        # built lazily over this process's engine. spec_stats is the
+        # LEGACY tier's aggregate accept record (the scheduler tiers
+        # carry theirs on ServeStats.spec) — attached to /stats and
+        # /metrics in every tier, launch flags notwithstanding.
+        from ..runtime.stats import SpecStats
+
+        self.draft = draft
+        self.draft_len = int(draft_len or 0)
+        self._draft_model = None
+        self.spec_stats = SpecStats(
+            mode=(draft or ("lookup" if lookup_decode else "off")),
+            draft_len=self.draft_len or lookup_decode)
         # serve_batch > 0 runs the continuous-batching scheduler with this
         # many KV slots: /v1/completions and /v1/chat/completions enqueue
         # onto it, and POST /v1/batch/completions borrows its engine.
@@ -238,7 +255,9 @@ class ApiState:
                     replica_hosts=self.replica_hosts,
                     worker_config=self.worker_config,
                     slo_ttft_ms=self.slo_ttft_ms,
-                    slo_itl_ms=self.slo_itl_ms)
+                    slo_itl_ms=self.slo_itl_ms,
+                    draft=self.draft, draft_len=self.draft_len,
+                    draft_vocab=self.tokenizer.vocab_size)
             return self._scheduler
 
     def batch_engine(self):
@@ -246,6 +265,19 @@ class ApiState:
         KV cache per process; callers stepping it directly must hold
         Scheduler.exclusive())."""
         return self.scheduler().engine
+
+    def draft_model(self):
+        """The LEGACY path's DraftModel over this process's engine,
+        built once on first use (the scheduler tiers build their own
+        per generation through build_front_door — never this one)."""
+        if self._draft_model is None and self.draft:
+            from ..runtime.draft import build_draft
+
+            with self.engine_lock:
+                if self._draft_model is None:
+                    self._draft_model = build_draft(self.engine,
+                                                    self.draft)
+        return self._draft_model
 
 
 def _raw_prompt_body(body: dict) -> bool:
@@ -376,6 +408,7 @@ def _completion_chunks(state: ApiState, body: dict):
     # in lock-step (the --lookup-decode flag itself is in the cluster
     # config fingerprint)
     use_lookup = state.lookup_decode > 0
+    use_draft = state.draft is not None
     history = list(tokens)  # every prompt position is written by prefill
     # history bookkeeping ownership: the lookup streams do NOT append their
     # emitted tokens (their K/V is already written by the verify forward, so
@@ -386,7 +419,25 @@ def _completion_chunks(state: ApiState, body: dict):
     # stays aligned with real K/V positions.
     speculating = False
     try:
-        if use_lookup and sampler.temperature == 0.0:
+        if use_draft and sampler.temperature == 0.0:
+            # real-draft speculation (runtime/draft.py): bit-identical
+            # greedy stream, drafts from the model's own truncated-depth
+            # prefix (or a separate draft .m) — pays on arbitrary text
+            speculating = True
+            token_iter = engine.generate_draft_stream(
+                suffix, n_gen, history=tokens,
+                draft=state.draft_model(), draft_len=state.draft_len or 7,
+                vocab_size=tokenizer.vocab_size)
+        elif use_draft and sampler.temperature > 0.0:
+            speculating = True
+            token_iter = engine.generate_draft_sampled_stream(
+                suffix, n_gen, history=tokens,
+                draft=state.draft_model(),
+                temperature=sampler.temperature, topp=sampler.topp,
+                seed=sampler.next_seed(),
+                draft_len=state.draft_len or 7,
+                vocab_size=tokenizer.vocab_size)
+        elif use_lookup and sampler.temperature == 0.0:
             speculating = True
             token_iter = engine.generate_lookup_stream(
                 suffix, n_gen, history=tokens,
@@ -416,6 +467,16 @@ def _completion_chunks(state: ApiState, body: dict):
         sampler.set_temp(saved_temp)
         if saved_rng_state is not None:
             sampler.rng_state = saved_rng_state
+        if speculating:
+            # fold the request's accept record into the LEGACY tier's
+            # aggregate `spec` block (the scheduler tiers count inline)
+            ls = getattr(engine, "last_spec", None)
+            if ls:
+                ss = state.spec_stats
+                ss.verify_forwards += ls["forwards"]
+                ss.drafted += ls["drafted"]
+                ss.accepted += ls["accepted"]
+                ss.emitted_spec += ls["emitted"]
     yield ("done", {"finish_reason": finish,
                     "prompt_tokens": n_prompt,
                     "completion_tokens": emitted})
@@ -845,7 +906,12 @@ def make_handler(state: ApiState):
                 # stats read must never be the thing that allocates the
                 # batched cache — report idle until a request builds it.
                 if state.serve_batch <= 0:
-                    payload = {"scheduler": "off"}
+                    # legacy tier: the speculative accept record still
+                    # answers (a tier must not lose the family to a
+                    # launch flag — the scheduler tiers carry theirs on
+                    # the summary)
+                    payload = {"scheduler": "off",
+                               "spec": state.spec_stats.summary()}
                 elif state._scheduler is None:
                     payload = {"scheduler": "idle"}
                 else:
@@ -927,6 +993,11 @@ def make_handler(state: ApiState):
                 from ..runtime.profiler import COMPILES
 
                 payload["compiles"] = COMPILES.summary()
+            if "spec" not in payload and not state.router_mode:
+                # legacy/idle tiers: the process-level accept record
+                # (router tiers carry the family per replica — the
+                # aggregate summary deliberately has no top-level block)
+                payload["spec"] = state.spec_stats.summary()
             if ("hbm" not in payload and state.engine is not None
                     and not state.router_mode):
                 from ..runtime.profiler import hbm_ledger
@@ -1551,6 +1622,15 @@ def serve(args) -> None:
         sys.exit("error: --replica-procs/--replica-hosts do not compose "
                  "with --nnodes (each worker is its own single-host "
                  "engine; see ROADMAP item 2 for the composition)")
+    if replica_hosts_raw and getattr(args, "draft", None):
+        # same contract as the --slo-* refusal below: pre-started
+        # workers own their configs — the parent cannot arm drafting in
+        # them, and a silently plain-decoding fleet the operator
+        # believes is speculating is the dead-flag hazard this
+        # discipline exists for (review-found)
+        sys.exit("error: --draft does not reach --replica-hosts workers "
+                 "(their configs are their operators'): pass --draft in "
+                 "each worker's own config instead")
     if replica_hosts_raw and (slo_ttft is not None or slo_itl is not None):
         # pre-started workers were launched with their OWN configs; the
         # parent cannot arm a policy in them (unlike --replica-procs,
@@ -1652,6 +1732,30 @@ def serve(args) -> None:
         engine, tokenizer, sampler = build_front_template(args)
     else:
         engine, tokenizer, sampler = build_engine(args)
+    draft_spec = getattr(args, "draft", None)
+    if draft_spec:
+        # depth bound needs the spec — validate at STARTUP, not on the
+        # first request (runtime/draft.parse_draft_spec already vetted
+        # the format at parse time)
+        from ..runtime.draft import parse_draft_spec
+        kind, arg = parse_draft_spec(draft_spec)
+        if kind == "self" and not 1 <= int(arg) < engine.spec.n_layers:
+            sys.exit(f"error: --draft self:{arg}: depth must be in "
+                     f"1..{engine.spec.n_layers - 1} (the model has "
+                     f"{engine.spec.n_layers} layers)")
+        if kind == "model" and getattr(engine, "mesh", None) is not None:
+            # DraftModel.from_file refuses meshed targets — fail at
+            # STARTUP where the mesh is known, not mid-serve inside the
+            # lazily-built supervisor (review-found; the legacy api
+            # path is the only way to combine --draft with a mesh,
+            # --serve-batch already refuses meshes)
+            sys.exit("error: --draft model:PATH needs a mesh-less "
+                     "engine (use --draft self:<depth>, which shares "
+                     "the target's sharded buffers)")
+        if worker_config is not None:
+            # the verify argmax truncates at the TOKENIZER vocab; the
+            # workers have no tokenizer, so the bound ships in the config
+            worker_config["draft_vocab"] = tokenizer.vocab_size
     prefix_block_len = getattr(args, "prefix_block_len", None) or 32
     if getattr(args, "prefix_cache", False):
         # validate the arena config against the REAL engine context at
@@ -1704,6 +1808,9 @@ def serve(args) -> None:
                      prefix_block_len=prefix_block_len,
                      slo_ttft_ms=slo_ttft, slo_itl_ms=slo_itl,
                      autosize=autosize,
+                     draft=draft_spec,
+                     draft_len=(getattr(args, "draft_len", None) or 7
+                                if draft_spec else 0),
                      replicas=replicas,
                      retry_budget=(1 if getattr(args, "retry_budget", None)
                                    is None else args.retry_budget),

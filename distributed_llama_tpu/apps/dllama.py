@@ -146,6 +146,33 @@ def build_argparser() -> argparse.ArgumentParser:
                         "rejection-style, distribution-exact vs the host "
                         "sampler (different RNG stream). Net-new: the "
                         "reference is strictly 1 token/forward")
+    p.add_argument("--draft", default=None, metavar="self:D|model:PATH",
+                   help="REAL-draft speculative decoding (runtime/draft"
+                        ".py): 'self:D' runs the model's own first D "
+                        "layers + logits head as a zero-extra-weights "
+                        "draft (reuses the loaded buffers, keeps a small "
+                        "D-layer KV cache); 'model:PATH' loads a "
+                        "separate draft .m (same tokenizer) onto the "
+                        "same machinery. Greedy output is BIT-IDENTICAL "
+                        "to the plain stream (drafts only batch the "
+                        "confirmation — and unlike --lookup-decode they "
+                        "pay on ARBITRARY text, not just repetitive "
+                        "text); temperature > 0 uses general rejection "
+                        "resampling (min(1, p/q) accept against the "
+                        "draft's real distribution), distribution-exact. "
+                        "In api mode with --serve-batch, every slot "
+                        "drafts per row through one fixed-width verify "
+                        "forward, and the SLO admission policy degrades "
+                        "to no-speculation when inter-token latency "
+                        "endangers --slo-itl-ms. Mutually exclusive "
+                        "with --lookup-decode")
+    p.add_argument("--draft-len", type=int, default=None, metavar="K",
+                   help="with --draft: tokens proposed per draft forward "
+                        "(default 7). The verify width is 1 + K and is "
+                        "compiled once; larger K amortizes more per "
+                        "accept but wastes more draft work when the "
+                        "draft diverges (watch dllama_spec_accept_rate, "
+                        "docs/serving.md)")
     p.add_argument("--serve-batch", type=_int_or_auto, default=0,
                    metavar="B|auto",
                    help="api mode: run the continuous-batching scheduler "
@@ -763,6 +790,42 @@ def cmd_generate(args, benchmark: bool) -> None:
         _safe_print(tokenizer.decode_piece(prev[0], tok).decode("utf-8", errors="replace"))
         prev[0] = tok
 
+    if args.draft:
+        # real-draft speculation (runtime/draft.py): greedy is
+        # bit-identical to the plain stream, sampled is
+        # distribution-exact via general rejection resampling
+        from ..runtime.draft import build_draft
+        try:
+            draft = build_draft(engine, args.draft)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        dl = args.draft_len or 7
+        t0 = time.perf_counter()
+        with _maybe_profile(args):
+            if args.temperature > 0:
+                res = engine.generate_draft_sampled(
+                    tokens, _steps(args, engine), draft=draft,
+                    temperature=float(np.float32(args.temperature)),
+                    topp=float(np.float32(args.topp)),
+                    seed=sampler.rng_state,
+                    eos_id=tokenizer.stop_token_ids(), draft_len=dl,
+                    on_token=on_token, vocab_size=tokenizer.vocab_size)
+            else:
+                res = engine.generate_draft(
+                    tokens, _steps(args, engine), draft=draft,
+                    eos_id=tokenizer.stop_token_ids(), draft_len=dl,
+                    on_token=on_token, vocab_size=tokenizer.vocab_size)
+        dt = time.perf_counter() - t0
+        print()
+        if benchmark:
+            fwd, n = engine.last_accept_stats
+            print(f"Generated tokens:    {n} in {fwd} forwards "
+                  f"({n / max(fwd, 1):.2f} tokens/forward, "
+                  f"draft {args.draft})")
+            print(f"Wall time:           {dt:.2f} s (includes draft + "
+                  "verify compiles)")
+        return
+
     if args.lookup_decode:
         _announce_run(tokens, _steps(args, engine), sampler=sampler,
                       lookup=args.lookup_decode)
@@ -902,8 +965,16 @@ def cmd_chat(args) -> None:
         sys.exit("error: --lookup-decode does not compose with --nnodes")
     check_session_flags(args)
     engine, tokenizer, sampler = build_engine(args)
+    chat_draft = None
+    if args.draft:
+        from ..runtime.draft import build_draft
+        try:
+            chat_draft = build_draft(engine, args.draft)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
     convo: list[int] = []  # whole-conversation tokens: the draft miner's
-    # n-gram source (chat history is full of quotable n-grams)
+    # n-gram source (chat history is full of quotable n-grams) AND the
+    # real draft's catch-up stream (token at position i = convo[i])
     resumed = False
     if args.session and os.path.exists(args.session):
         convo = engine.load_session(args.session)
@@ -946,7 +1017,25 @@ def cmd_chat(args) -> None:
             break
         budget = min(_steps(args, engine), remaining)
         convo.extend(tokens)
-        if args.lookup_decode:
+        if chat_draft is not None:
+            # real-draft turns: the draft's own forward proposes — the
+            # chat history is its catch-up stream, not an n-gram mine
+            dl = args.draft_len or 7
+            if args.temperature > 0:
+                res = engine.generate_draft_sampled(
+                    tokens, budget, draft=chat_draft,
+                    temperature=args.temperature, topp=args.topp,
+                    seed=sampler.rng_state, eos_id=stops, draft_len=dl,
+                    on_token=on_token, vocab_size=tokenizer.vocab_size,
+                    history=convo)
+                sampler.set_seed(sampler.rng_state + len(res.tokens) + 1)
+            else:
+                res = engine.generate_draft(
+                    tokens, budget, draft=chat_draft, eos_id=stops,
+                    draft_len=dl, on_token=on_token,
+                    vocab_size=tokenizer.vocab_size, history=convo)
+            convo.extend(res.tokens)
+        elif args.lookup_decode:
             # chat turns speculate, mining drafts from the WHOLE
             # conversation so far — prior turns are the richest n-gram
             # source. Greedy turns are token-stream-exact; sampled turns
@@ -1122,6 +1211,38 @@ def main(argv: list[str] | None = None) -> None:
     # pp contract holes closed at PARSE time, before any engine or cluster
     # work: a flag combination that cannot work must not cost a model load
     # (or, worse, be silently ignored for a whole run)
+    if args.draft_len is not None and not args.draft:
+        sys.exit("error: --draft-len has no effect without --draft "
+                 "(self:<depth> or model:<path>)")
+    if args.draft_len is not None and args.draft_len < 1:
+        sys.exit("error: --draft-len must be >= 1")
+    if args.draft:
+        if args.lookup_decode:
+            sys.exit("error: --draft and --lookup-decode both pick the "
+                     "draft source — use one (the real draft pays on "
+                     "arbitrary text; prompt lookup only on repetitive)")
+        from ..runtime.draft import parse_draft_spec
+        try:
+            kind, arg = parse_draft_spec(args.draft)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        if kind == "model":
+            import os as _os
+            if not _os.path.exists(arg):
+                sys.exit(f"error: --draft model:{arg}: no such file")
+        if args.nnodes > 1:
+            sys.exit("error: --draft does not compose with --nnodes "
+                     "(the worker protocol has no draft replay)")
+        if args.pp > 1:
+            sys.exit("error: --draft does not compose with --pp "
+                     "(stage-stacked layers cannot be depth-sliced)")
+        if args.dp > 1:
+            sys.exit("error: --draft is single-sequence outside api "
+                     "mode and per-slot inside it; it does not compose "
+                     "with --dp")
+        if args.device_sampling:
+            sys.exit("error: --draft is host-loop decoding; it does "
+                     "not compose with --device-sampling")
     if args.session and args.pp > 1:
         sys.exit("error: --session does not compose with --pp > 1 — "
                  "save_session fetches the KV cache to the host, and "

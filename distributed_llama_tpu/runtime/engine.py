@@ -836,16 +836,20 @@ class Engine:
         stats: RunStats | None,
         first_fn: Callable[[np.ndarray], int],
         verify_fn: Callable[[np.ndarray, list[int]], list[int]],
+        draft_fn: Callable | None = None,
     ) -> Iterator[int]:
-        """The verify-forward skeleton both speculative modes share —
+        """The verify-forward skeleton every speculative mode shares —
         draft sizing, the compiled verify step, eos/budget truncation,
         cache-position bookkeeping, accept stats and timing live HERE
-        exactly once. Modes differ only in their two callbacks:
-        first_fn(logits row) -> first token, and
+        exactly once. Modes differ only in their callbacks:
+        first_fn(logits row) -> first token,
         verify_fn(seg_logits (T, V), draft) -> emitted tokens, where
         emitted = the accepted draft prefix plus exactly one more token
         (emitted[i] must be a valid continuation of segment position i —
-        its K/V slot holds the fed token stream)."""
+        its K/V slot holds the fed token stream), and — for REAL-draft
+        modes (runtime/draft.py) — draft_fn(hist, k, token, pos0) ->
+        draft token list, replacing the default prompt-lookup n-gram
+        miner (the draft model owns its KV state inside the closure)."""
         stop_ids = ({eos_id} if isinstance(eos_id, int) else eos_id) or set()
 
         from .speculative import find_draft
@@ -856,6 +860,8 @@ class Engine:
             # server's plain token iterator at n_gen == 0
             self.prefill(prompt)
             self.last_accept_stats = (1, 0)
+            self.last_spec = {"forwards": 1, "drafted": 0, "accepted": 0,
+                              "emitted": 0}
             return
 
         t0 = time.perf_counter()
@@ -869,6 +875,11 @@ class Engine:
         token = first_fn(logits_np[0])
         n_out = 1
         self.last_accept_stats = (1, 1)
+        # the richer accept record the legacy API tier aggregates into
+        # its `spec` /stats block (accepted counts tokens actually USED
+        # after eos/budget truncation — the honest numerator)
+        self.last_spec = {"forwards": 1, "drafted": 0, "accepted": 0,
+                          "emitted": 1}
         hist = np.asarray((history if history is not None else prompt)
                           + [token], np.int32)
         yield token
@@ -880,9 +891,13 @@ class Engine:
             g0 = time.perf_counter()
             k = min(draft_len, self.seq_len - self.pos - 1,
                     max_tokens - n_out - 1)
-            draft = find_draft(hist, k, max_ngram=max_ngram) if k > 0 else []
-            seg = np.asarray([[token] + draft], np.int32)
             pos0 = self.pos
+            if draft_fn is not None:
+                draft = draft_fn(hist, k, token, pos0) if k > 0 else []
+            else:
+                draft = (find_draft(hist, k, max_ngram=max_ngram)
+                         if k > 0 else [])
+            seg = np.asarray([[token] + draft], np.int32)
 
             # device_ms covers only the verify forward + the logits D2H
             # (like generate()'s step timing); draft mining and the host
@@ -915,6 +930,10 @@ class Engine:
             self.pos = pos0 + 1 + a
             n_out += len(emitted)
             self.last_accept_stats = (self.last_accept_stats[0] + 1, n_out)
+            self.last_spec["forwards"] += 1
+            self.last_spec["drafted"] += len(draft)
+            self.last_spec["accepted"] += max(a, 0)
+            self.last_spec["emitted"] += len(emitted)
             hist = np.concatenate([hist, np.asarray(emitted, np.int32)])
             token = emitted[-1]
             g1 = time.perf_counter()
@@ -1053,6 +1072,225 @@ class Engine:
                                  history=history, stats=stats,
                                  first_fn=first, verify_fn=verify)
 
+    # -- real-draft speculative generation (runtime/draft.py) -------------
+
+    def _draft_catchup(self, draft, state: dict, hist: np.ndarray,
+                       target_pos: int) -> None:
+        """Bring a draft's KV cache frontier up to ``target_pos`` by
+        prefilling the token stream it missed (hist[i] is the token at
+        absolute position i). Chunks pad to ONE fixed width (pad writes
+        land beyond the real frontier and are overwritten before the
+        draft attends them — the engine-wide overrun invariant), so
+        catch-up adds no compile keys however ragged the gaps are. Gaps
+        happen at start (the whole prompt) and whenever a round skipped
+        drafting (k == 0 at a budget edge)."""
+        c = min(self.prefill_chunk, self.seq_len)
+        target = min(int(target_pos), len(hist))
+        while state["pos"] < target:
+            dp = state["pos"]
+            n = min(c, target - dp)
+            tok = np.zeros((self.batch, c), np.int32)
+            tok[0, :n] = hist[dp:dp + n]
+            pos = np.full((self.batch,), self.seq_len, np.int32)
+            pos[0] = dp
+            state["cache"] = draft.prefill_chunk(state["cache"], tok, pos)
+            state["pos"] = dp + n
+
+    def generate_draft_stream(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        eos_id: int | set[int] | None = None,
+        *,
+        draft,
+        draft_len: int = 7,
+        history: list[int] | None = None,
+        stats: RunStats | None = None,
+        vocab_size: int | None = None,
+    ) -> Iterator[int]:
+        """Greedy REAL-draft speculative decoding (runtime/draft.py): the
+        draft model (`DraftModel` — the target's own truncated-depth
+        prefix, or a separate draft .m) proposes k tokens in ONE
+        dispatched scan, the verify forward confirms accepted-prefix + 1
+        exactly like the lookup path, and the emitted stream is EXACTLY
+        generate()'s greedy stream — drafts only batch the confirmation,
+        on ANY text (prompt lookup needs repetitive text to propose at
+        all). The draft keeps its own d-layer KV cache inside this
+        stream's closure, walking the same absolute positions as the
+        target; rejected draft positions are overwritten by the next
+        round's feed (the engine-wide overrun invariant), and a stale
+        draft cache can only lower the accept rate, never change a
+        token. `last_accept_stats` updates per forward like the lookup
+        modes. batch must be 1 (the scheduler owns the batched path)."""
+        assert self.batch == 1, "use the scheduler for batched drafting"
+        from .speculative import count_accepted
+
+        spec_v = min(vocab_size or self.spec.vocab_size,
+                     self.spec.vocab_size)
+        state = {"cache": draft.new_cache(), "pos": 0}
+
+        def first(row: np.ndarray) -> int:
+            return int(np.argmax(row[:spec_v]))
+
+        def verify(seg_logits: np.ndarray, dr: list[int]) -> list[int]:
+            greedy = np.argmax(seg_logits[:, :spec_v], axis=-1)
+            m = count_accepted(dr, greedy)
+            return [int(g) for g in greedy[: m + 1]]
+
+        def draft_fn(hist, k, token, pos0):
+            self._draft_catchup(draft, state, hist, pos0)
+            # always scan the FULL draft_len (one compile key) and
+            # truncate to k: the extra steps are d/L-cheap and their
+            # writes sit beyond the frontier. state["pos"] may then
+            # exceed the VERIFIED frontier past a rejection — safe
+            # HERE because every next round's scan re-feeds
+            # contiguously from the new pos0, overwriting each stale
+            # position before its own query attends it (the scheduler
+            # path must clamp instead: plain rounds can interleave
+            # there — Scheduler._decode_spec)
+            toks, state["cache"] = draft.propose(
+                state["cache"], np.asarray([token], np.int32),
+                np.asarray([pos0], np.int32), draft_len, n_vocab=spec_v)
+            state["pos"] = pos0 + draft_len
+            return [int(t) for t in toks[0][:k]]
+
+        return self._lookup_loop(prompt, max_tokens, eos_id,
+                                 draft_len=draft_len, max_ngram=0,
+                                 history=history, stats=stats,
+                                 first_fn=first, verify_fn=verify,
+                                 draft_fn=draft_fn)
+
+    def generate_draft(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        eos_id: int | set[int] | None = None,
+        *,
+        draft,
+        draft_len: int = 7,
+        on_token: Callable[[int], None] | None = None,
+        vocab_size: int | None = None,
+        history: list[int] | None = None,
+    ) -> GenerationResult:
+        """Collecting wrapper over generate_draft_stream (the CLI path)."""
+        stats = RunStats()
+        out: list[int] = []
+        for t in self.generate_draft_stream(prompt, max_tokens, eos_id,
+                                            draft=draft,
+                                            draft_len=draft_len,
+                                            stats=stats,
+                                            vocab_size=vocab_size,
+                                            history=history):
+            out.append(t)
+            if on_token:
+                on_token(t)
+        return GenerationResult(out, stats)
+
+    def generate_draft_sampled_stream(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        *,
+        draft,
+        temperature: float,
+        topp: float,
+        seed: int,
+        eos_id: int | set[int] | None = None,
+        draft_len: int = 7,
+        vocab_size: int | None = None,
+        history: list[int] | None = None,
+        stats: RunStats | None = None,
+    ) -> Iterator[int]:
+        """Sampled REAL-draft speculation via GENERAL rejection
+        resampling (speculative.accept_or_resample_q): the draft SAMPLES
+        each proposal from its own temperature/top-p distribution q (a
+        real, non-point-mass proposal — unlike prompt-lookup's onehot
+        drafts), and the target accepts with min(1, p/q), resampling the
+        normalized residual max(p - q, 0) on the first reject. Every
+        emitted token is distributed exactly as a host-Sampler draw on
+        the same logits; the RNG stream is a derived numpy PCG64 like
+        the sampled lookup mode (coin parity with the plain path is
+        impossible by construction). The draft loop here is host-paced
+        (one d-layer forward per proposal — sampling is data-dependent,
+        so it cannot fuse into the greedy scan); the greedy mode is the
+        latency headline."""
+        from .speculative import (accept_or_resample_q, draw, target_dist)
+
+        assert self.batch == 1, "use the scheduler for batched drafting"
+        assert temperature > 0, "temperature 0 is the parity-exact greedy mode"
+        spec_v = min(vocab_size or self.spec.vocab_size,
+                     self.spec.vocab_size)
+        rng = np.random.default_rng(seed)
+        state = {"cache": draft.new_cache(), "pos": 0, "q": []}
+
+        def first(row: np.ndarray) -> int:
+            return draw(target_dist(row, temperature, topp, spec_v),
+                        rng.random())
+
+        def draft_fn(hist, k, token, pos0):
+            self._draft_catchup(draft, state, hist, pos0)
+            toks: list[int] = []
+            qs: list[np.ndarray] = []
+            cur, p, cache = int(token), int(pos0), state["cache"]
+            for _ in range(k):
+                lg, cache = draft.step_logits(
+                    cache, np.asarray([[cur]], np.int32),
+                    np.asarray([p], np.int32))
+                qd = target_dist(lg[0], temperature, topp, spec_v)
+                cur = draw(qd, rng.random())
+                toks.append(cur)
+                qs.append(qd)
+                p += 1
+            state["cache"], state["pos"], state["q"] = cache, p, qs
+            return toks
+
+        def verify(seg_logits: np.ndarray, dr: list[int]) -> list[int]:
+            emitted: list[int] = []
+            for i, d in enumerate(dr):
+                p_i = target_dist(seg_logits[i], temperature, topp, spec_v)
+                ok, t = accept_or_resample_q(p_i, state["q"][i], int(d),
+                                             rng.random(), rng.random())
+                emitted.append(t)
+                if not ok:
+                    return emitted
+            p_k = target_dist(seg_logits[len(dr)], temperature, topp,
+                              spec_v)
+            emitted.append(draw(p_k, rng.random()))
+            return emitted
+
+        return self._lookup_loop(prompt, max_tokens, eos_id,
+                                 draft_len=draft_len, max_ngram=0,
+                                 history=history, stats=stats,
+                                 first_fn=first, verify_fn=verify,
+                                 draft_fn=draft_fn)
+
+    def generate_draft_sampled(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        *,
+        draft,
+        temperature: float,
+        topp: float,
+        seed: int,
+        eos_id: int | set[int] | None = None,
+        draft_len: int = 7,
+        on_token: Callable[[int], None] | None = None,
+        vocab_size: int | None = None,
+        history: list[int] | None = None,
+    ) -> GenerationResult:
+        """Collecting wrapper over generate_draft_sampled_stream."""
+        stats = RunStats()
+        out: list[int] = []
+        for t in self.generate_draft_sampled_stream(
+                prompt, max_tokens, draft=draft, temperature=temperature,
+                topp=topp, seed=seed, eos_id=eos_id, draft_len=draft_len,
+                vocab_size=vocab_size, history=history, stats=stats):
+            out.append(t)
+            if on_token:
+                on_token(t)
+        return GenerationResult(out, stats)
+
     # -- continuous-batching slot steps (runtime/scheduler.py) ------------
 
     def slot_prefill_chunk(self, tokens: np.ndarray, pos: np.ndarray,
@@ -1129,6 +1367,52 @@ class Engine:
         logits, self.cache = self._steps[key](self.params, tok, posv,
                                               self.cache)
         return logits
+
+    def slot_verify_step(self, tokens: np.ndarray, pos: np.ndarray,
+                         n_vocab: int) -> tuple[np.ndarray, np.ndarray]:
+        """One FIXED-WIDTH speculative verify step for the slot
+        scheduler: row r feeds its (1 + K) segment [last token, draft...]
+        at absolute positions pos[r]..pos[r]+K (the generate_batch_lookup
+        padding trick as a slot executable — rows without a draft pad
+        with their own token, gated rows pass pos[r] == seq_len and every
+        write drops). Returns (greedy (B, 1+K) int32 — the target's
+        argmax AFTER each segment position, computed ON DEVICE over the
+        tokenizer vocab, and the position-0 logits (B, vocab) np — what a
+        plain slot_decode_step would have returned, so non-speculating
+        rows ride the same forward and sample normally).
+
+        The width 1 + K and n_vocab are the ONLY compile keys
+        ("slot_verify"): the scheduler always pads to its configured
+        draft_len, so speculative serving mints exactly one verify
+        executable, warmed by Scheduler.warmup() — the bounded-key
+        discipline --freeze-compiles enforces. Unconfirmed draft writes
+        beyond each row's accepted prefix are overwritten before any
+        later query attends them (the engine-wide overrun invariant).
+        self.pos untouched (per-slot positions are the scheduler's)."""
+        from .draft import batched_verify
+
+        b, t = tokens.shape
+        assert b == self.batch, (b, self.batch)
+        key = ("slot_verify", t, int(n_vocab))
+        if key not in self._steps:
+            common = self._forward_kwargs()
+            spec = self.spec
+
+            def run(params, tok, pos, cache, nv=int(n_vocab)):
+                return batched_verify(params, spec, tok, pos, cache,
+                                      n_vocab=nv, fwd_kwargs=common)
+
+            run.__name__ = f"slot_verify_{t}"
+            self._mint(key, jax.jit(run, donate_argnums=(3,)))
+        tok = jnp.asarray(tokens, jnp.int32)
+        posv = jnp.asarray(pos, jnp.int32)
+        if self._token_sharding is not None:
+            tok = jax.device_put(tok, self._token_sharding)
+            posv = jax.device_put(posv,
+                                  NamedSharding(self.mesh, P(DP_AXIS)))
+        greedy, logits0, self.cache = self._steps[key](
+            self.params, tok, posv, self.cache)
+        return np.asarray(greedy), self.fetch_logits(logits0)
 
     # -- prefix-cache arena steps (runtime/prefix_cache.py) ---------------
 

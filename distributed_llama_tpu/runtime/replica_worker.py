@@ -583,7 +583,13 @@ def build_supervisor_factory(cfg: dict):
         # the worker's own step timeline; its block rides the stats
         # reply like every other per-replica block)
         slo_ttft_ms=serve.get("slo_ttft_ms"),
-        slo_itl_ms=serve.get("slo_itl_ms"))
+        slo_itl_ms=serve.get("slo_itl_ms"),
+        # per-slot speculative decoding (runtime/draft.py): each worker
+        # builds its own DraftModel over its own engine per generation —
+        # the spec string ships, never weight buffers; model-draft
+        # workers load the draft .m themselves like the target .m
+        draft=cfg.get("draft"), draft_len=int(cfg.get("draft_len", 0)),
+        draft_vocab=cfg.get("draft_vocab"))
 
     return lambda: EngineSupervisor(engine_factory, **sup_kwargs)
 
@@ -605,6 +611,17 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
         "prefix_blocks": int(getattr(args, "prefix_blocks", 0) or 0),
         "prefix_block_len": int(getattr(args, "prefix_block_len", None)
                                 or 32),
+        # speculative decoding (runtime/draft.py): the draft SPEC ships
+        # (the worker builds the DraftModel over its own engine);
+        # draft_vocab is filled in by the api server once the tokenizer
+        # is loaded (the verify argmax truncates at the tokenizer vocab).
+        # The draft-len DEFAULT (7) applies here like on the local tiers
+        # — argparse's sentinel is None, and shipping 0 with a draft
+        # armed would trip the scheduler's draft_len >= 1 assertion in
+        # every worker (review-found; regression-tested)
+        "draft": getattr(args, "draft", None),
+        "draft_len": int(getattr(args, "draft_len", None)
+                         or (7 if getattr(args, "draft", None) else 0)),
         "serve": {
             "chunk": getattr(args, "serve_chunk", 0),
             "max_queue": getattr(args, "queue_depth", 0),

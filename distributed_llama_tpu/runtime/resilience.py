@@ -87,7 +87,9 @@ class EngineSupervisor:
                  prefix_blocks: int = 0, prefix_block_len: int = 32,
                  fault_key: str | None = None,
                  slo_ttft_ms: float | None = None,
-                 slo_itl_ms: float | None = None):
+                 slo_itl_ms: float | None = None,
+                 draft: str | None = None, draft_len: int = 0,
+                 draft_vocab: int | None = None):
         self._factory = engine_factory
         self._chunk = chunk
         # replica identity at the key-filtered fault sites (runtime/
@@ -108,6 +110,13 @@ class EngineSupervisor:
         # the dead engine's steps; the new one re-learns in a few steps)
         self._slo_ttft_ms = slo_ttft_ms
         self._slo_itl_ms = slo_itl_ms
+        # per-slot speculative decoding (runtime/draft.py): the spec
+        # string ("self:2" / "model:PATH") is rebuilt into a DraftModel
+        # PER GENERATION inside _make_sched — a self-draft's params are
+        # views of the dying engine's buffers and must never outlive it
+        self._draft = draft
+        self._draft_len = int(draft_len)
+        self._draft_vocab = draft_vocab
         self.max_queue = int(max_queue)
         self._queue_timeout = queue_timeout
         self._request_deadline = request_deadline
@@ -349,13 +358,22 @@ class EngineSupervisor:
 
             pc = PrefixCache(engine, num_blocks=self._prefix_blocks,
                              block_len=self._prefix_block_len)
+        draft_factory = None
+        if self._draft:
+            from .draft import build_draft
+
+            spec_str = self._draft
+            draft_factory = lambda eng: build_draft(eng, spec_str)  # noqa: E731
         return Scheduler(engine, chunk=self._chunk,
                          max_queue=self.max_queue,
                          queue_timeout=self._queue_timeout,
                          request_deadline=self._request_deadline,
                          prefix_cache=pc, fault_key=self._fault_key,
                          slo_ttft_ms=self._slo_ttft_ms,
-                         slo_itl_ms=self._slo_itl_ms)
+                         slo_itl_ms=self._slo_itl_ms,
+                         draft_factory=draft_factory,
+                         draft_len=self._draft_len,
+                         draft_vocab=self._draft_vocab)
 
     def _start_loop(self, sched: Scheduler, gen: int) -> None:
         for g in [g for g, t in self._loop_threads.items()

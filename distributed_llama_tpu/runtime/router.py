@@ -1256,7 +1256,9 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                      worker_io_timeout: float = 30.0,
                      spawn_timeout: float = 300.0,
                      slo_ttft_ms: float | None = None,
-                     slo_itl_ms: float | None = None):
+                     slo_itl_ms: float | None = None,
+                     draft: str | None = None, draft_len: int = 0,
+                     draft_vocab: int | None = None):
     """The ONE constructor of the serving front door, shared by every
     deployment shape (the engine-owner logic that used to live in
     apps/api_server.ApiState.scheduler):
@@ -1339,7 +1341,8 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         request_deadline=request_deadline or None,
         stall_timeout=stall_timeout or 10.0,
         prefix_blocks=n_blocks, prefix_block_len=prefix_block_len,
-        slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
+        slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
+        draft=draft, draft_len=draft_len, draft_vocab=draft_vocab)
     if replicas <= 1:
         return EngineSupervisor(engine_factory, **sup_kwargs)
     return Router(engine_factory, replicas=replicas,

@@ -236,6 +236,23 @@ class AdmissionPolicy:
         self.ttft_ewma_ms: float | None = None
         self.shrinks = 0
         self.widens = 0
+        # the "degrade — no speculation" actuator (ROADMAP item 2's
+        # overload degrade, wired here where the live ITL signal is): a
+        # speculative verify forward is WIDER than a plain decode step,
+        # so when the ITL EWMA endangers the SLO the policy turns
+        # drafting off before (independently of) shrinking the chunk
+        # ladder, and re-arms it once ITL sits comfortably under the
+        # target again. Same hysteresis bands as the width walk.
+        self.spec_on = True
+        self.spec_disables = 0
+        self.spec_enables = 0
+
+    @property
+    def spec_allowed(self) -> bool:
+        """Whether the scheduler may run speculative verify steps this
+        iteration (runtime/draft.py per-slot drafting consults this
+        before every draft dispatch)."""
+        return self.spec_on
 
     @property
     def width(self) -> int:
@@ -257,6 +274,18 @@ class AdmissionPolicy:
         requests currently running."""
         if decode_rows:
             self.itl_ewma_ms = self._mix(self.itl_ewma_ms, float(wall_ms))
+        # speculation actuator first: it is independent of the width
+        # cooldown (turning drafting off must not wait out a recent
+        # chunk transition — the verify width is the bigger lever)
+        if self.slo_itl_ms and self.itl_ewma_ms is not None:
+            if (self.spec_on
+                    and self.itl_ewma_ms > self.shrink_frac * self.slo_itl_ms):
+                self.spec_on = False
+                self.spec_disables += 1
+            elif (not self.spec_on
+                  and self.itl_ewma_ms < self.widen_frac * self.slo_itl_ms):
+                self.spec_on = True
+                self.spec_enables += 1
         self._since_change += 1
         if self._since_change < self.cooldown:
             return
@@ -292,6 +321,9 @@ class AdmissionPolicy:
             "ttft_ewma_ms": rnd(self.ttft_ewma_ms),
             "shrinks": self.shrinks,
             "widens": self.widens,
+            "spec_allowed": self.spec_on,
+            "spec_disables": self.spec_disables,
+            "spec_enables": self.spec_enables,
         }
 
 
@@ -300,9 +332,16 @@ class _Slot:
     None, PREFILL while off < len(prompt), DECODE after. `pos` is the next
     cache write position, `last` the token to feed next step. `pins` is
     the prefix-cache path the slot was seeded from (held until the slot
-    releases so eviction can't free its source blocks)."""
+    releases so eviction can't free its source blocks). With per-slot
+    drafting armed (runtime/draft.py): `draft_pos` is the row's draft-KV
+    frontier (positions < draft_pos of the draft cache hold the true
+    stream — host bookkeeping only, reset on every lease like the main
+    cache's; the next lease's prefill overwrites the predecessor's draft
+    K/V before the draft can attend it) and `toks` the fed-token history
+    draft catch-up chunks read from (prompt + emitted tokens)."""
 
-    __slots__ = ("idx", "req", "pos", "off", "n_out", "last", "pins")
+    __slots__ = ("idx", "req", "pos", "off", "n_out", "last", "pins",
+                 "draft_pos", "toks")
 
     def __init__(self, idx: int):
         self.idx = idx
@@ -312,6 +351,8 @@ class _Slot:
         self.n_out = 0
         self.last = 0
         self.pins: tuple = ()
+        self.draft_pos = 0
+        self.toks: list[int] = []
 
 
 class Scheduler:
@@ -320,7 +361,9 @@ class Scheduler:
                  request_deadline: float | None = None,
                  prefix_cache=None, fault_key: str | None = None,
                  slo_ttft_ms: float | None = None,
-                 slo_itl_ms: float | None = None):
+                 slo_itl_ms: float | None = None,
+                 draft_factory=None, draft_len: int = 0,
+                 draft_vocab: int | None = None):
         self.engine = engine
         # identifies THIS scheduler at the replica-level fault sites
         # (runtime/faults.py replica_raise/replica_stall): the router
@@ -352,6 +395,26 @@ class Scheduler:
         self.max_queue = int(max_queue)
         self.queue_timeout = queue_timeout
         self.request_deadline = request_deadline
+        # per-slot REAL-draft speculation (runtime/draft.py): the factory
+        # builds a DraftModel over THIS scheduler's engine (a supervisor
+        # rebuild passes a fresh engine — the draft's params are views of
+        # its buffers and must die with it). One batched draft KV cache
+        # serves every slot; per-slot frontiers live on the slots.
+        from .stats import SpecStats
+
+        self.draft = draft_factory(engine) if draft_factory else None
+        self.draft_len = int(draft_len) if self.draft is not None else 0
+        assert self.draft is None or self.draft_len >= 1, \
+            "a draft without a draft length proposes nothing"
+        # device-argmax vocab for greedy verify: the TOKENIZER's vocab
+        # (the host Sampler truncates there — sampler.py:69). Requests
+        # whose sampler vocab differs simply never speculate.
+        self.draft_vocab = int(draft_vocab or engine.spec.vocab_size)
+        self.draft_cache = (self.draft.new_cache()
+                            if self.draft is not None else None)
+        self._spec_stats = SpecStats(
+            mode=(self.draft.label if self.draft is not None else "off"),
+            draft_len=self.draft_len)
         # deque.append/popleft are atomic under the GIL, so submit() never
         # touches the step mutex: a submitter must not wait out an
         # in-flight forward (measured: mutex-taking submits stalled a
@@ -364,6 +427,8 @@ class Scheduler:
         if prefix_cache is not None:
             self.stats.prefix = prefix_cache.stats
         self.stats.admission = self.admission  # None when no SLO is set
+        self.stats.spec = self._spec_stats  # always attached (mode "off"
+        # when no draft: a tier must not lose the family to a launch flag)
         self._thread: threading.Thread | None = None
         self._stop = False
         self._closed = False
@@ -533,11 +598,29 @@ class Scheduler:
               else self.chunk) if pre else 0
         if pre:
             self._prefill_chunk(pre, cw)
+        # per-slot drafting (runtime/draft.py): the admission policy's
+        # "degrade — no speculation" actuator gates every draft dispatch
+        # — when the live ITL EWMA endangers the SLO, the scheduler
+        # falls back to plain (B, 1) decode steps until it recovers
+        spec_ok = (self.draft is not None
+                   and (self.admission is None
+                        or self.admission.spec_allowed))
+        if self.draft is not None and dec and not spec_ok:
+            self._spec_stats.degraded_steps += 1
+        if spec_ok:
+            # one draft catch-up chunk per iteration: rows whose draft
+            # frontier trails the target (fresh admissions, prefix-cache
+            # seeded prompts the draft must prefill itself, k == 0
+            # rounds) advance up to one chunk — d/L of a target chunk
+            self._draft_catchup_chunk()
         if dec:
             # rows that finished their prompt inside _prefill_chunk above
             # wait for the NEXT iteration: every live row gets at most one
             # decode forward per iteration (bounded ITL under admission)
-            self._decode(dec)
+            if spec_ok and any(self._spec_capable(s) for s in dec):
+                self._decode_spec(dec)
+            else:
+                self._decode(dec)
         if TRACER.enabled:
             # step timeline: batch composition + wall ms, the raw
             # measurement behind /metrics' dllama_step_ms and the bench
@@ -591,6 +674,13 @@ class Scheduler:
             s.n_out = 0
             s.last = 0
             s.pins = ()
+            # per-slot draft state resets with the lease (finish, cancel,
+            # deadline, and abort all come back through here): the new
+            # request's draft prefill overwrites the predecessor's draft
+            # K/V before the draft can attend it — the same invariant as
+            # the main cache's slot reuse
+            s.draft_pos = 0
+            s.toks = list(req.prompt)
             if TRACER.enabled:
                 TRACER.event("admit", req.trace_id, slot=s.idx,
                              queue_ms=round(
@@ -682,6 +772,136 @@ class Scheduler:
             s.pos += 1
             self._emit(s, s.req.sampler.sample(lg[s.idx]))
 
+    # -- per-slot real-draft speculation (runtime/draft.py) ----------------
+
+    def _spec_capable(self, s: _Slot) -> bool:
+        """Whether slot s can ride a speculative verify THIS iteration:
+        greedy request (verification is the target's argmax — sampled
+        rows would need per-row rejection chains, they ride the same
+        verify forward's position-0 logits instead), sampler truncated
+        at the scheduler's verify vocab, draft caught up to the target
+        frontier, and at least 2 tokens of budget AND context headroom
+        (drafting for a single remaining token buys nothing)."""
+        req = s.req
+        smp = req.sampler
+        return (getattr(smp, "temperature", None) == 0.0
+                and getattr(smp, "vocab_size", 0) == self.draft_vocab
+                and s.draft_pos >= s.pos
+                and req.max_tokens - s.n_out >= 2
+                and self.engine.seq_len - s.pos >= 2)
+
+    def _draft_catchup_chunk(self) -> None:
+        """One batched (B, C) draft prefill chunk covering every slot
+        whose draft-KV frontier trails what the target has written (the
+        fed-token history is `s.toks`, capped at the written frontier —
+        the final emitted token is never fed, there or here). Fixed
+        width C = the configured chunk (ONE compile key however ragged
+        the gaps); chunk-tail padding writes land beyond each row's
+        frontier and are overwritten before the draft attends them."""
+        eng, c = self.engine, self.chunk
+        rows = []
+        for s in self.slots:
+            if s.req is None:
+                continue
+            smp = s.req.sampler
+            if not (getattr(smp, "temperature", None) == 0.0
+                    and getattr(smp, "vocab_size", 0) == self.draft_vocab):
+                # a row that can never speculate (sampled request,
+                # foreign vocab) gets no draft K/V — catch-up for it
+                # would be a pure extra dispatch per iteration
+                continue
+            avail = min(len(s.toks), max(s.off, s.pos))
+            if s.draft_pos < avail:
+                rows.append((s, avail))
+        if not rows:
+            return
+        tok = np.zeros((eng.batch, c), np.int32)
+        pos = np.full((eng.batch,), eng.seq_len, np.int32)
+        for s, avail in rows:
+            n = min(c, avail - s.draft_pos)
+            tok[s.idx, :n] = s.toks[s.draft_pos:s.draft_pos + n]
+            pos[s.idx] = s.draft_pos
+            s.draft_pos += n
+        self.draft_cache = self.draft.prefill_chunk(self.draft_cache,
+                                                    tok, pos)
+        self._spec_stats.draft_forwards += 1
+
+    def _decode_spec(self, rows: list[_Slot]) -> None:
+        """The speculative decode iteration: ONE draft-scan dispatch
+        proposes draft_len tokens per speculating row, ONE fixed-width
+        verify forward confirms each row's accepted prefix + 1 — every
+        row advances 1..draft_len+1 tokens per iteration at exact greedy
+        parity (emission is always the TARGET's argmax; a wrong draft
+        costs only its cheap forwards). Non-speculating rows (sampled,
+        vocab-mismatched, draft catching up) ride the SAME verify
+        forward: their segment pads with their own token and they sample
+        one token from the position-0 logits — a (B, 1+K) forward costs
+        ~one weight read like (B, 1), which is the whole bet."""
+        from .speculative import count_accepted
+
+        eng, k = self.engine, self.draft_len
+        spec_rows = [s for s in rows if self._spec_capable(s)]
+        dtok = np.zeros((eng.batch,), np.int32)
+        dpos = np.full((eng.batch,), eng.seq_len, np.int32)  # gated rows
+        for s in spec_rows:
+            dtok[s.idx] = s.last
+            dpos[s.idx] = s.pos
+        drafts_np, self.draft_cache = self.draft.propose(
+            self.draft_cache, dtok, dpos, k, n_vocab=self.draft_vocab)
+        self._spec_stats.draft_forwards += 1
+        tok = np.zeros((eng.batch, 1 + k), np.int32)
+        pos = np.full((eng.batch,), eng.seq_len, np.int32)
+        drafts: dict[int, list[int]] = {}
+        for s in rows:
+            tok[s.idx, :] = s.last  # pad = the row's own token (its
+            # writes sit beyond the accepted prefix and are overwritten
+            # before any later query attends them)
+            pos[s.idx] = s.pos
+        for s in spec_rows:
+            # the scan always proposes k (one compile key); clamp to the
+            # row's budget/headroom — surplus drafts become padding
+            kk = min(k, eng.seq_len - s.pos - 1,
+                     s.req.max_tokens - s.n_out - 1)
+            d = [int(t) for t in drafts_np[s.idx][:kk]]
+            drafts[s.idx] = d
+            tok[s.idx, 1:1 + len(d)] = d
+            s.draft_pos = s.pos + k  # the scan wrote pos..pos+k-1
+        greedy, lg0 = eng.slot_verify_step(tok, pos, self.draft_vocab)
+        self._spec_stats.verify_forwards += 1
+        for s in rows:
+            d = drafts.get(s.idx)
+            if d is None:
+                s.pos += 1
+                self._emit(s, s.req.sampler.sample(lg0[s.idx]))
+                continue
+            req = s.req
+            m = count_accepted(d, greedy[s.idx])
+            emitted = [int(g) for g in greedy[s.idx][: m + 1]]
+            self._spec_stats.drafted += len(d)
+            self._spec_stats.accepted += m
+            self._spec_stats.emitted_spec += len(emitted)
+            req.stats.spec_forwards += 1
+            req.stats.spec_drafted += len(d)
+            req.stats.spec_accepted += m
+            pos0 = s.pos
+            for t in emitted:
+                s.pos += 1
+                self._emit(s, t)
+                if s.req is None:  # stop/budget retired the slot: the
+                    break          # rest of the accepts are discarded
+            if s.req is not None:
+                # clamp the draft frontier to the TRUE verified stream:
+                # positions past the first rejection hold rejected-token
+                # K/V. The next speculative scan would overwrite them
+                # contiguously before attending them — but intervening
+                # PLAIN rounds (SLO degrade, budget tail) advance s.pos
+                # without touching the draft cache, and a later catch-up
+                # starting at an inflated draft_pos would leave the
+                # stale entries below the frontier, silently decaying
+                # the accept rate for the rest of the stream
+                # (review-found)
+                s.draft_pos = min(pos0 + k, s.pos)
+
     def _emit(self, s: _Slot, token: int) -> None:
         """Record one sampled token and retire the slot the moment the
         request is done — the freed slot is admissible next iteration.
@@ -693,6 +913,7 @@ class Scheduler:
         token = int(token)
         s.n_out += 1
         s.last = token
+        s.toks.append(token)  # the draft catch-up's fed-token history
         now = time.perf_counter()
         if req.stats.t_first is None:
             req.stats.t_first = now
@@ -752,6 +973,15 @@ class Scheduler:
         req.stats.t_done = time.perf_counter()
         self.stats.requests_finished += 1
         if TRACER.enabled:
+            if req.stats.spec_forwards:
+                # the request's honest accept record, on its span — what
+                # dlprof needs to attribute verify-forward cost per
+                # request (one event per request, not per verify)
+                TRACER.event("spec", req.trace_id,
+                             forwards=req.stats.spec_forwards,
+                             drafted=req.stats.spec_drafted,
+                             accepted=req.stats.spec_accepted,
+                             key=self.fault_key)
             TRACER.event("finish", req.trace_id, reason=reason,
                          n_out=req.stats.n_out)
         req.events.put(("done", reason))
@@ -780,6 +1010,21 @@ class Scheduler:
                 eng.slot_prefill_chunk(np.zeros((eng.batch, w), np.int32),
                                        gate, np.zeros((eng.batch,), np.int32))
             eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32), gate)
+            if self.draft is not None:
+                # the draft key set is planned and bounded: one prefill
+                # width, one scan shape, one verify width — compile all
+                # three here (all rows gated: state-neutral by the same
+                # OOB invariant) so speculative traffic mints ZERO
+                # post-warmup keys and --freeze-compiles stays green
+                self.draft_cache = self.draft.prefill_chunk(
+                    self.draft_cache,
+                    np.zeros((eng.batch, self.chunk), np.int32), gate)
+                _, self.draft_cache = self.draft.propose(
+                    self.draft_cache, np.zeros((eng.batch,), np.int32),
+                    gate, self.draft_len, n_vocab=self.draft_vocab)
+                eng.slot_verify_step(
+                    np.zeros((eng.batch, 1 + self.draft_len), np.int32),
+                    gate, self.draft_vocab)
             if self.prefix_cache is not None:
                 # the seed/publish executables compile here too — a
                 # rebuilt engine's first prefix-cache admission must not

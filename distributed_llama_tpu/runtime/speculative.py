@@ -104,6 +104,31 @@ def accept_or_resample(p: np.ndarray, d: int, u_accept: float,
     return False, draw(r, u_res)
 
 
+def accept_or_resample_q(p: np.ndarray, q: np.ndarray, d: int,
+                         u_accept: float, u_res: float) -> tuple[bool, int]:
+    """The GENERAL rejection-resampling step (Leviathan/Chen speculative
+    sampling): the draft token d was SAMPLED from a non-point-mass
+    proposal distribution q (a real draft model's own softmax — the
+    self-draft's truncated-depth head, or a separate draft ``.m``), and
+    the target distribution is p. Accept d with probability
+    min(1, p(d)/q(d)); on reject, sample from the normalized residual
+    max(p - q, 0). Marginalizing over (d ~ q, u_accept, u_res)
+    reproduces p EXACTLY — the point-mass helper above is the q =
+    onehot(d) special case. Returns (accepted, token)."""
+    pd, qd = float(p[d]), float(q[d])
+    # qd <= 0 means d cannot have been drawn from this q — certain
+    # reject (min(1, p/q) is ill-defined; the residual stays exact)
+    if qd > 0.0 and u_accept < min(1.0, pd / qd):
+        return True, d
+    r = np.maximum(p - q, 0.0)
+    s = r.sum()
+    if s <= 0.0:
+        # p <= q pointwise means p == q (both sum to 1): the accept
+        # probability was exactly p(d)/q(d) = 1 — rejection is impossible
+        return True, d
+    return False, draw(r / s, u_res)
+
+
 def count_accepted(draft: list[int], greedy: np.ndarray) -> int:
     """How many leading draft tokens the verify forward confirmed: greedy[i]
     is the model's argmax AFTER segment position i, so draft token i (fed at

@@ -85,6 +85,9 @@ EVENT_KINDS = (
     #                   reason, phase — linked under the active trace id)
     "worker_exit",    # replica worker process died (replica, cls, rc)
     "respawn",        # worker respawned to routable (replica, ms)
+    "spec",           # terminal speculative-decoding accept record for
+    #                   one request (forwards, drafted, accepted) —
+    #                   dlprof attributes verify-forward cost from it
     "step",           # scheduler iteration (timeline record)
     "handshake",      # cluster control star formed (role, peers)
     "cluster_tick",   # one cluster protocol frame handled (phase, rank)
@@ -668,6 +671,49 @@ def _add_cluster(p: _Prom, cluster: dict | None) -> None:
                     "minus local wall, at the best-RTT sample)")
 
 
+_SPEC_COUNTERS = (
+    ("verify_forwards", "spec_verify_forwards_total",
+     "Fixed-width speculative verify forwards dispatched"),
+    ("draft_forwards", "spec_draft_forwards_total",
+     "Draft dispatches (one k-token scan or prefill chunk == one)"),
+    ("drafted", "spec_drafted_tokens_total",
+     "Draft tokens proposed to the verifier"),
+    ("accepted", "spec_accepted_tokens_total",
+     "Draft tokens the verify forward confirmed"),
+    ("emitted_spec", "spec_emitted_tokens_total",
+     "Tokens emitted by speculating rows"),
+    ("degraded_steps", "spec_degraded_steps_total",
+     "Iterations the SLO admission policy ran with drafting disabled"),
+)
+
+
+def _add_spec(p: _Prom, spec: dict | None, *, labels: dict | None = None,
+              prefix: str = "dllama_") -> None:
+    """The speculative-decoding family (runtime/draft.py accept record,
+    stats.SpecStats summary): honest accept-rate observability in every
+    tier — the block is attached even with drafting off (mode "off",
+    zeros), so the family can never vanish off a launch flag. One
+    renderer for the top-level summary and each replica's block
+    (`dllama_replica_spec_*`, replica-labelled)."""
+    if not spec:
+        return
+    per = " (per replica)" if prefix != "dllama_" else ""
+    p.add(f"{prefix}spec_mode", 1,
+          {**(labels or {}), "mode": _esc(spec.get("mode", "off")),
+           "draft_len": str(spec.get("draft_len", 0))},
+          help_=f"Draft mode in effect (info-style: constant 1){per}")
+    for key, name, help_ in _SPEC_COUNTERS:
+        p.add(f"{prefix}{name}", spec.get(key), labels, type_="counter",
+              help_=help_ + per)
+    p.add(f"{prefix}spec_accept_rate", spec.get("accept_rate"), labels,
+          help_="Accepted / drafted over the scheduler generation — the "
+                "number that says whether speculation pays on this "
+                f"traffic (docs/operations.md){per}")
+    p.add(f"{prefix}spec_tokens_per_verify", spec.get("tokens_per_verify"),
+          labels,
+          help_=f"Mean tokens emitted per verify forward{per}")
+
+
 def _add_admission(p: _Prom, adm: dict | None, *,
                    labels: dict | None = None,
                    prefix: str = "dllama_") -> None:
@@ -758,6 +804,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                                  .get("knee_basis"))},
                   help_="Batch knee that capped the auto-sizing")
         _add_admission(p, summary.get("admission"))
+        _add_spec(p, summary.get("spec"))
         _add_device_blocks(p, summary)
         for rep in summary.get("replicas") or ():
             lab = {"replica": str(rep.get("replica"))}
@@ -782,6 +829,11 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
             # multi-replica tier would lose it entirely, the PR-8 rule)
             _add_admission(p, rep.get("admission"), labels=lab,
                            prefix="dllama_replica_")
+            # per-replica accept record (each replica's scheduler owns
+            # its own SpecStats — on router tiers the family rides the
+            # replica label, same rule as admission)
+            _add_spec(p, rep.get("spec"), labels=lab,
+                      prefix="dllama_replica_")
             _add_device_blocks(p, rep, labels=lab)
             proc = rep.get("proc")
             if proc:
