@@ -53,7 +53,12 @@ it), and BENCH_AUTOTUNE=1 to add the closed batch-knee-loop row
 SLO-aware adaptive chunk admission, A/B'd against static settings on
 goodput-at-SLO with greedy token parity and zero post-warmup compiles;
 BENCH_AUTOTUNE_REQUESTS/_TOKENS/_BATCHES/_STATIC/_SLO_TTFT_MS/
-_SLO_ITL_MS/_IAT/_LONG size it), and BENCH_SPEC=1 to add the REAL-draft
+_SLO_ITL_MS/_IAT/_LONG size it), BENCH_KVX=1 to add the cross-replica KV
+block transfer row (_kvx_row: cold-replica fills OFF vs ON on a
+shared-prefix trace — TTFT p50, fill hit rate, wire bytes reconciled —
+plus the disaggregated prefill/decode A/B;
+BENCH_KVX_FAMILIES/_SYS/_BLOCK/_TOKENS/_IAT/_LONG/_STREAMS size it), and
+BENCH_SPEC=1 to add the REAL-draft
 speculative-decoding row (_spec_row: truncated-depth self-draft vs
 prompt-lookup vs plain greedy on a fixed-seed NON-repetitive eval with
 the measured accept rate ON the row, plus a Poisson serving A/B with
@@ -2101,6 +2106,232 @@ def _grok_row(repeats: int) -> dict:
     return row
 
 
+def _kvx_row(params, spec: ModelSpec, prefix: str) -> dict:
+    """Cross-replica KV block transfer row (the ISSUE-14 metric,
+    runtime/kv_transfer.py), two passes:
+
+    1. COLD-REPLICA FILL A/B — a shared-prefix Poisson-paced trace of
+       family pairs served by a 2-replica router (in-process
+       ReplicaServers behind connect-mode handles: every frame crosses
+       a REAL socket) under round-robin placement, so each family's
+       second request lands on the replica that has NEVER seen it.
+       Transfer OFF: the cold replica re-prefills the family prefix.
+       Transfer ON: it fetches the donor's published blocks
+       (RMSG_BLOCK_*) and prefills only the tail. Reported: cold-request
+       TTFT p50 OFF vs ON (acceptance: >= 30% better ON), fill hit
+       rate, measured BLOCK_DATA wire bytes RECONCILED against the
+       frame-size arithmetic (25% bar; exact by construction), greedy
+       TOKEN PARITY between the runs, and zero post-warmup compiles
+       with the ledger FROZEN through the ON serve.
+
+    2. DISAGGREGATED PREFILL/DECODE A/B — a decode-heavy stream with
+       long prompts arriving concurrently, served by (a) ONE unified
+       mixed replica and (b) a prefill-tier + decode-tier pair (equal
+       decode capacity). Reported: the decode stream's ITL p99 + the
+       long prompts' TTFT p50 under both shapes, parity + zero
+       failures asserted (the perf delta is the finding, CPU timing is
+       not asserted).
+
+    Env knobs: BENCH_KVX_FAMILIES (6), BENCH_KVX_SYS (64),
+    BENCH_KVX_BLOCK (16), BENCH_KVX_TOKENS (8), BENCH_KVX_IAT (0.02),
+    BENCH_KVX_LONG (96), BENCH_KVX_STREAMS (4)."""
+    import gc
+    import time
+
+    from distributed_llama_tpu.parallel.multihost import frame_bytes
+    from distributed_llama_tpu.runtime import kv_transfer as kvx
+    from distributed_llama_tpu.runtime.engine import Engine as _Eng
+    from distributed_llama_tpu.runtime.netstats import (
+        estimate_block_transfer, reconcile_wire)
+    from distributed_llama_tpu.runtime.profiler import COMPILES
+    from distributed_llama_tpu.runtime.replica_worker import ReplicaServer
+    from distributed_llama_tpu.runtime.resilience import EngineSupervisor
+    from distributed_llama_tpu.runtime.router import (RemoteReplicaHandle,
+                                                      Router)
+    from distributed_llama_tpu.sampler import Sampler
+
+    n_fam = int(os.environ.get("BENCH_KVX_FAMILIES", "6"))
+    sys_len = int(os.environ.get("BENCH_KVX_SYS", "64"))
+    bl = int(os.environ.get("BENCH_KVX_BLOCK", "16"))
+    budget = int(os.environ.get("BENCH_KVX_TOKENS", "8"))
+    iat = float(os.environ.get("BENCH_KVX_IAT", "0.02"))
+    long_len = int(os.environ.get("BENCH_KVX_LONG", "96"))
+    n_streams = int(os.environ.get("BENCH_KVX_STREAMS", "4"))
+    seq = min(512, spec.seq_len)
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+    b = 2
+
+    def sup_factory(key=None):
+        def make_engine():
+            return _Eng(spec, params, batch=b, compute_dtype=cdt,
+                        cache_dtype=cdt, max_seq_len=seq)
+        # chunk = block_len, like the prefix row: the A/B measures
+        # chunked prefill vs block fills — a chunk wider than the whole
+        # prompt would hide the prefill cost inside one fixed-width
+        # forward and measure nothing
+        return lambda: EngineSupervisor(
+            make_engine, chunk=bl,
+            prefix_blocks=max(2 * b * seq // bl, 64),
+            prefix_block_len=bl, kv_transfer=True, stall_timeout=60.0,
+            fault_key=key)
+
+    def cluster(tiers, *, transfer, policy="round_robin"):
+        servers = [ReplicaServer(sup_factory(f"r{i}"),
+                                 kv_transfer=transfer, tier=t)
+                   for i, t in enumerate(tiers)]
+        ports = [s.start() for s in servers]
+        handles = [RemoteReplicaHandle(i, address=("127.0.0.1", p),
+                                       block_len=bl, poll_interval=0.1)
+                   for i, p in enumerate(ports)]
+        router = Router(None, policy=policy,
+                        handle_factories=[(lambda h=h: h)
+                                          for h in handles],
+                        kv_transfer=transfer, fill_min_tokens=bl)
+        return servers, handles, router
+
+    def greedy():
+        return Sampler(spec.vocab_size, temperature=0.0, topp=0.9,
+                       seed=7)
+
+    rng = np.random.default_rng(0)
+    fams = [rng.integers(1, spec.vocab_size, sys_len).astype(
+        np.int64).tolist() for _ in range(n_fam)]
+    tails = [rng.integers(1, spec.vocab_size, 4 + (i % 3) * 4).astype(
+        np.int64).tolist() for i in range(n_fam)]
+    gaps = rng.exponential(iat, n_fam)
+
+    def run_fill_trace(transfer):
+        """Pairs per family: the warm request places on r0 (round robin)
+        and publishes; the cold one places on r1. Returns (tokens,
+        cold-TTFT list, servers) — servers still open for ledger reads."""
+        servers, _handles, router = cluster(("mixed", "mixed"),
+                                            transfer=transfer)
+        if transfer:
+            COMPILES.freeze = True  # acceptance: the ON serve mints
+            # zero post-warmup keys (a violation fails requests loudly)
+        outs, cold_ttft = [], []
+        try:
+            for i, (fam, tail) in enumerate(zip(fams, tails)):
+                time.sleep(min(gaps[i], 0.2))
+                prompt = fam + tail
+                warm = router.submit(prompt, budget, greedy())
+                outs.append(list(warm.tokens(timeout=120)))
+                cold = router.submit(prompt, budget, greedy())
+                outs.append(list(cold.tokens(timeout=120)))
+                assert cold.replica_id != warm.replica_id
+                cold_ttft.append(cold.stats.ttft_ms)
+        finally:
+            COMPILES.freeze = False
+        summary = router.summary()
+        router.close()
+        return outs, sorted(cold_ttft), servers, summary
+
+    warm_compiles = COMPILES.after_warmup
+    outs_off, ttft_off, servers_off, _ = run_fill_trace(False)
+    for s in servers_off:
+        s.shutdown()
+    outs_on, ttft_on, servers_on, summ_on = run_fill_trace(True)
+    frozen_delta = COMPILES.after_warmup - warm_compiles
+
+    # the measured block-frame ledger vs the exact frame arithmetic
+    agg = summ_on["kv_transfer"]
+    measured_data = sum(
+        srv.kvx_stats.wire.peer_bytes(peer, "BLOCK_DATA", "rx")
+        for srv in servers_on for peer in (0, 1))
+    per_block = kvx.block_payload_bytes(
+        spec.n_layers, spec.n_kv_heads, bl, spec.head_size, cdt)
+    modeled_data = agg["blocks_filled"] * frame_bytes(1, per_block)
+    rec = reconcile_wire(measured_data, modeled_data)
+    est = estimate_block_transfer(
+        spec, tokens=agg["blocks_filled"] * bl, block_len=bl,
+        cache_bytes=jnp.dtype(cdt).itemsize)
+    for s in servers_on:
+        s.shutdown()
+
+    # -- pass 2: disaggregated prefill/decode A/B ------------------------
+    longs = [rng.integers(1, spec.vocab_size, long_len).astype(
+        np.int64).tolist() for _ in range(n_streams)]
+    shorts = [rng.integers(1, spec.vocab_size, 8).astype(
+        np.int64).tolist() for _ in range(n_streams)]
+
+    def run_disagg(tiers, transfer):
+        servers, _h, router = cluster(tiers, transfer=transfer)
+        outs, itls, ttfts = [], [], []
+        try:
+            import threading as _th
+            results = {}
+
+            def serve(tag, prompt, toks):
+                r = router.submit(prompt, toks, greedy())
+                results[tag] = (list(r.tokens(timeout=180)), r.stats)
+
+            threads = []
+            for i in range(n_streams):
+                threads.append(_th.Thread(
+                    target=serve, args=(f"s{i}", shorts[i], 24)))
+                threads.append(_th.Thread(
+                    target=serve, args=(f"l{i}", longs[i], 4)))
+            for t in threads:
+                t.start()
+                time.sleep(iat)
+            for t in threads:
+                t.join(timeout=240)
+            for i in range(n_streams):
+                toks, st = results[f"s{i}"]
+                outs.append(toks)
+                if st.itl_ms is not None:
+                    itls.append(st.itl_ms)
+                toks_l, st_l = results[f"l{i}"]
+                outs.append(toks_l)
+                ttfts.append(st_l.ttft_ms)
+        finally:
+            router.close()
+            for s in servers:
+                s.shutdown()
+        itls.sort()
+        ttfts.sort()
+        return outs, {
+            "itl_p99_ms": round(itls[-1], 3) if itls else None,
+            "itl_p50_ms": round(itls[len(itls) // 2], 3)
+            if itls else None,
+            "long_ttft_p50_ms": round(ttfts[len(ttfts) // 2], 3)
+            if ttfts else None,
+        }
+
+    outs_uni, uni = run_disagg(("mixed",), transfer=False)
+    outs_dis, dis = run_disagg(("prefill", "decode"), transfer=True)
+
+    gc.collect()
+    ttft_off_p50 = ttft_off[len(ttft_off) // 2]
+    ttft_on_p50 = ttft_on[len(ttft_on) // 2]
+    gain = (ttft_off_p50 - ttft_on_p50) / ttft_off_p50 \
+        if ttft_off_p50 else 0.0
+    return {
+        "metric": f"{prefix}_kv_transfer_cold_ttft_gain_pct",
+        "value": round(100.0 * gain, 2),
+        "unit": "%", "vs_baseline": None,
+        "families": n_fam, "shared_prefix_tokens": sys_len,
+        "block_len": bl,
+        "token_parity": outs_on == outs_off,
+        "token_parity_disagg": outs_dis == outs_uni,
+        "cold_ttft_p50_ms_off": round(ttft_off_p50, 3),
+        "cold_ttft_p50_ms_on": round(ttft_on_p50, 3),
+        "fill_hit_rate": (round(agg["fills_ok"]
+                                / agg["fills_requested"], 4)
+                          if agg["fills_requested"] else None),
+        "fills_ok": agg["fills_ok"],
+        "fill_fallbacks": agg["fill_fallbacks"],
+        "tokens_filled": agg["tokens_filled"],
+        "blocks_filled": agg["blocks_filled"],
+        "bytes_rx": agg["bytes_rx"],
+        "compiles_after_warmup": frozen_delta,
+        "unified": uni, "disaggregated": dis,
+        "kv_transfer": {**agg, "reconcile": rec},
+        "wire_model": est,
+        "reconcile": rec,
+    }
+
+
 def main() -> None:
     model = os.environ.get("BENCH_MODEL", "7b")
     # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
@@ -2243,6 +2474,16 @@ def main() -> None:
                 # respawn-to-routable latency, availability %, zero
                 # unstreamed failures, token parity
                 emit(_router_procs_row(prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_KVX", "0") != "0":
+            # cross-replica KV block transfer row (runtime/
+            # kv_transfer.py): the shared-prefix trace with cold-replica
+            # fills OFF vs ON (TTFT p50, fill hit rate, measured block
+            # frames reconciled against the frame arithmetic, greedy
+            # parity, zero frozen-ledger compiles) plus the
+            # disaggregated prefill/decode A/B against a unified tier
+            emit(_with_step_timeline(_kvx_row, params, spec,
+                                     prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_SPEC", "0") != "0":
             # real-draft speculative decoding row (runtime/draft.py):

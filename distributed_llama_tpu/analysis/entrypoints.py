@@ -174,6 +174,35 @@ def entry_points(max_devices: int | None = None,
         {"activation_elems": mb_x * bl_x * spec_x.n_kv_heads
          * spec_x.head_size, "dim": spec_x.dim}))
 
+    # block_export / block_import: the cross-replica KV transfer plane's
+    # two arena executables (runtime/kv_transfer.py) — traced through
+    # the SAME module-level bodies the engine jits
+    # (engine.export_arena_block / import_arena_block), so the pinned
+    # fingerprints cover the real donor/importer paths: a drifting
+    # block-index dtype here would retrace per transferred block.
+    from ..runtime.engine import export_arena_block, import_arena_block
+
+    def block_export(arena_k, arena_v, src):
+        return export_arena_block(arena_k, arena_v, src)
+
+    out.append(EntryPoint(
+        "block_export", block_export,
+        (arena_k, arena_v, jnp.int32(0)),
+        {"activation_elems": bl_x * spec_x.n_kv_heads * spec_x.head_size,
+         "dim": spec_x.dim}))
+
+    blk_k = jnp.zeros(arena_shape[1:], jnp.float32)
+    blk_v = jnp.zeros(arena_shape[1:], jnp.float32)
+
+    def block_import(arena_k, arena_v, k_blk, v_blk, dst):
+        return import_arena_block(arena_k, arena_v, k_blk, v_blk, dst)
+
+    out.append(EntryPoint(
+        "block_import", block_import,
+        (arena_k, arena_v, blk_k, blk_v, jnp.int32(0)),
+        {"activation_elems": bl_x * spec_x.n_kv_heads * spec_x.head_size,
+         "dim": spec_x.dim}))
+
     # -- speculative-decoding serving executables (runtime/draft.py) ------
     # draft_forward: the k-step greedy draft scan (truncated-depth spec —
     # n_layers 1 of the tiny 2 mirrors the self-draft slice). Traced

@@ -116,7 +116,8 @@ class ApiState:
                  slo_ttft_ms: float | None = None,
                  slo_itl_ms: float | None = None,
                  autosize: dict | None = None,
-                 draft: str | None = None, draft_len: int = 0):
+                 draft: str | None = None, draft_len: int = 0,
+                 kv_transfer: bool = False, tiers=None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -175,6 +176,11 @@ class ApiState:
         self.replicas = replicas
         self.retry_budget = retry_budget
         self.route_policy = route_policy
+        # KV block transfer + prefill/decode disaggregation (runtime/
+        # kv_transfer.py): the enable flag and the per-replica roles
+        # build_front_door stamps into handles/worker configs
+        self.kv_transfer = bool(kv_transfer)
+        self.tiers = tiers
         # PROCESS-isolated replica tier (runtime/replica_worker.py):
         # replica_procs spawns N supervised worker processes locally
         # (each its own interpreter — a segfault/SIGKILL/OOM costs one
@@ -257,7 +263,8 @@ class ApiState:
                     slo_ttft_ms=self.slo_ttft_ms,
                     slo_itl_ms=self.slo_itl_ms,
                     draft=self.draft, draft_len=self.draft_len,
-                    draft_vocab=self.tokenizer.vocab_size)
+                    draft_vocab=self.tokenizer.vocab_size,
+                    kv_transfer=self.kv_transfer, tiers=self.tiers)
             return self._scheduler
 
     def batch_engine(self):
@@ -929,6 +936,14 @@ def make_handler(state: ApiState):
                     # direction, heartbeat RTT, clock offsets
                     if cluster.get("wire"):
                         payload["wire"] = cluster["wire"]
+                if "kv_transfer" not in payload:
+                    # legacy/idle/single-supervisor tiers: the transfer
+                    # plane cannot exist here (it needs replicas), but
+                    # the family must not vanish off a launch flag —
+                    # the block answers enabled=False (router tiers
+                    # carry the real aggregate on their summary)
+                    from ..runtime.stats import KVTransferStats
+                    payload["kv_transfer"] = KVTransferStats().summary()
                 from ..runtime.trace import TRACER
                 if TRACER.enabled:
                     payload["trace"] = TRACER.summary()
@@ -998,6 +1013,11 @@ def make_handler(state: ApiState):
                 # (router tiers carry the family per replica — the
                 # aggregate summary deliberately has no top-level block)
                 payload["spec"] = state.spec_stats.summary()
+            if "kv_transfer" not in payload:
+                # same tier-invariance rule for the transfer plane: a
+                # legacy/idle scrape renders the family as enabled=False
+                from ..runtime.stats import KVTransferStats
+                payload["kv_transfer"] = KVTransferStats().summary()
             if ("hbm" not in payload and state.engine is not None
                     and not state.router_mode):
                 from ..runtime.profiler import hbm_ledger
@@ -1666,6 +1686,50 @@ def serve(args) -> None:
             or getattr(args, "route_policy", None) is not None):
         sys.exit("error: --retry-budget/--route-policy have no effect "
                  "without --replicas N > 1 or a process tier")
+    # KV block transfer + disaggregation (runtime/kv_transfer.py):
+    # dead-flag discipline — a transfer plane with nothing to transfer
+    # (no prefix cache) or nobody to transfer between (one replica) is
+    # silently-dead configuration
+    kv_transfer = bool(getattr(args, "kv_transfer", False))
+    tier_raw = getattr(args, "tier", None)
+    if kv_transfer and not getattr(args, "prefix_cache", False):
+        sys.exit("error: --kv-transfer moves published prefix-cache "
+                 "blocks and requires --prefix-cache")
+    n_fleet = (int(replica_procs) if replica_procs
+               else len(str(replica_hosts_raw).split(","))
+               if replica_hosts_raw else int(replicas))
+    if kv_transfer and n_fleet < 2:
+        sys.exit("error: --kv-transfer needs >= 2 replicas "
+                 "(--replicas N, --replica-procs N, or --replica-hosts "
+                 "h:p,...) — one replica has no sibling to transfer "
+                 "with")
+    tiers = None
+    if tier_raw is not None:
+        if not kv_transfer:
+            sys.exit("error: --tier requires --kv-transfer (a prefill-"
+                     "tier replica is useless unless its blocks can "
+                     "move to the decode tier)")
+        if replica_hosts_raw:
+            sys.exit("error: --tier does not reach --replica-hosts "
+                     "workers (their configs are their operators'): "
+                     "set `tier` in each worker's own config — the "
+                     "router adopts it from the health PONG")
+        n_rep = int(replica_procs) if replica_procs else int(replicas)
+        tiers = [t.strip() for t in str(tier_raw).split(",")]
+        if len(tiers) == 1:
+            tiers = tiers * n_rep
+        if len(tiers) != n_rep:
+            sys.exit(f"error: --tier lists {len(tiers)} roles for "
+                     f"{n_rep} replicas (one value, or one per replica)")
+        bad = [t for t in tiers if t not in ("prefill", "decode",
+                                             "mixed")]
+        if bad:
+            sys.exit(f"error: --tier roles must be prefill|decode|"
+                     f"mixed (got {bad[0]!r})")
+        if all(t == "prefill" for t in tiers):
+            sys.exit("error: --tier needs at least one decode or mixed "
+                     "replica (prefill-tier replicas never serve "
+                     "requests)")
     trace_on = bool(getattr(args, "trace", False))
     if not trace_on and (
             getattr(args, "trace_dir", None)
@@ -1820,7 +1884,8 @@ def serve(args) -> None:
                      replica_hosts=replica_hosts,
                      worker_config=worker_config,
                      admin_token=getattr(args, "admin_token", None),
-                     profile_dir=getattr(args, "profile_dir", None))
+                     profile_dir=getattr(args, "profile_dir", None),
+                     kv_transfer=kv_transfer, tiers=tiers)
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "

@@ -336,6 +336,34 @@ def build_argparser() -> argparse.ArgumentParser:
                         "tier. No spawn supervision: each worker's "
                         "lifetime belongs to its host's operator. "
                         "Mutually exclusive with --replica-procs")
+    # KV block transfer + prefill/decode disaggregation (runtime/
+    # kv_transfer.py, docs/serving.md "KV block transfer")
+    p.add_argument("--kv-transfer", action="store_true",
+                   help="api mode, with --prefix-cache and a replica "
+                        "tier: let replicas SHIP published KV blocks to "
+                        "each other (RMSG_BLOCK_* over the framed "
+                        "codec) — a replica placed cold on a prefix a "
+                        "sibling caches FETCHES the blocks and seeds "
+                        "them instead of re-prefilling (greedy outputs "
+                        "bit-identical, transfer failures degrade to a "
+                        "plain re-prefill). Also the carrier of --tier "
+                        "disaggregation. Block frames ride the dlwire "
+                        "ledger (dllama_kv_transfer_* on /metrics)")
+    p.add_argument("--tier", default=None, metavar="T[,T...]",
+                   help="api mode, with --kv-transfer: per-replica "
+                        "disaggregation roles (prefill|decode|mixed; "
+                        "one value applies to all, or a comma list "
+                        "matching the replica count). prefill-tier "
+                        "replicas run ONLY prompt prefills (big "
+                        "chunks, no decode occupancy) and stream their "
+                        "blocks to decode-tier replicas, which admit "
+                        "already-seeded — the vLLM-lineage split that "
+                        "kills prefill/decode interference. The router "
+                        "falls back to the unified mixed path when no "
+                        "prefill replica is routable. Not with "
+                        "--replica-hosts (set `tier` in each worker's "
+                        "own config; the router learns it from the "
+                        "health PONG)")
     p.add_argument("--admin-token", default=None, metavar="TOKEN",
                    help="api mode: bearer token accepted on /admin/* as "
                         "an alternative to the loopback-only default "

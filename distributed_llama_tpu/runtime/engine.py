@@ -82,6 +82,35 @@ def seed_rows_from_blocks(cache: KVCache, arena_k, arena_v, row, block_ids
     return KVCache(tuple(k_all), tuple(v_all))
 
 
+def export_arena_block(arena_k, arena_v, src):
+    """Gather ONE published arena block pair for the cross-replica KV
+    transfer plane (runtime/kv_transfer.py) — the traced body of
+    ``Engine.block_export`` (module-level so analysis/entrypoints.py
+    fingerprints the SAME program the engine jits). The arenas are only
+    READ (never donated: the block stays published locally); the caller
+    fetches the returned (layers, kv_heads, block_len, head_size) pair
+    to host and ships the raw bytes."""
+    src = jnp.asarray(src, jnp.int32)
+    return (lax.dynamic_index_in_dim(arena_k, src, 0, keepdims=False),
+            lax.dynamic_index_in_dim(arena_v, src, 0, keepdims=False))
+
+
+def import_arena_block(arena_k, arena_v, k_blk, v_blk, dst):
+    """Write one fetched block pair into arena slot ``dst`` — the traced
+    body of ``Engine.slot_import_block``. The arenas are donated
+    (in-place block write, same discipline as slot_publish_block). The
+    bytes are written RAW: the seeding boundary's f8 NaN-code guard
+    (seed_rows_from_blocks -> saturate_f8_nan_codes) runs when a slot is
+    SEEDED from the block, so foreign bytes can never decode as finite
+    480 in an attention read whatever their producer did."""
+    z = jnp.int32(0)
+    dst = jnp.asarray(dst, jnp.int32)
+    return (lax.dynamic_update_slice(arena_k, k_blk[None],
+                                     (dst, z, z, z, z)),
+            lax.dynamic_update_slice(arena_v, v_blk[None],
+                                     (dst, z, z, z, z)))
+
+
 class Engine:
     def __init__(
         self,
@@ -1489,6 +1518,35 @@ class Engine:
             self._mint(key, jax.jit(run, donate_argnums=(0, 1)))
         return self._steps[key](arena_k, arena_v, self.cache,
                                 jnp.int32(row), jnp.int32(offset),
+                                jnp.int32(dst))
+
+    # -- cross-replica KV block transfer (runtime/kv_transfer.py) ---------
+
+    def block_export(self, arena_k, arena_v, src: int):
+        """Gather arena block ``src`` as a device (L, KVH, bl, hs) K/V
+        pair for host export. One compilation key per block length
+        ("block_export" — src is a traced scalar), minted through the
+        compile ledger like every serving executable and warmed by
+        ``PrefixCache.warmup`` when transfer is enabled, so donor
+        serving mints ZERO post-warmup keys."""
+        key = ("block_export", arena_k.shape[3])
+        if key not in self._steps:
+            self._mint(key, jax.jit(export_arena_block))
+        return self._steps[key](arena_k, arena_v, jnp.int32(src))
+
+    def slot_import_block(self, arena_k, arena_v, k_blk, v_blk, dst: int):
+        """Write one fetched host block pair into arena slot ``dst`` and
+        return the updated (arena_k, arena_v) — the importer half of the
+        transfer plane. Arenas donated; one compilation key per block
+        length ("block_import"). See import_arena_block for why the
+        bytes land raw (the seed-side f8 guard owns trust)."""
+        key = ("block_import", arena_k.shape[3])
+        if key not in self._steps:
+            self._mint(key, jax.jit(import_arena_block,
+                                    donate_argnums=(0, 1)))
+        return self._steps[key](arena_k, arena_v,
+                                jnp.asarray(k_blk, self.cache_dtype),
+                                jnp.asarray(v_blk, self.cache_dtype),
                                 jnp.int32(dst))
 
     # -- batched speculative (prompt-lookup) greedy generation ------------

@@ -45,6 +45,19 @@ Socket-layer sites, fired inside the multihost control-plane frame codec
 side of the root<->worker star and assert bounded detection
 (tests/test_cluster_chaos.py):
 
+KV-transfer sites, fired in the donor's block-export loop
+(runtime/kv_transfer.py) so chaos tests can kill or wedge a transfer at
+an exact BLOCK_DATA frame (key-filtered like the replica sites — the
+donor worker's fault_key):
+
+  * ``kvx_stall``      — donor export loop, before a BLOCK_DATA send:
+                         blocks like ``step_stall`` (wedged donor — the
+                         importer's per-transfer deadline must fire and
+                         degrade to a local re-prefill)
+  * ``kvx_exit``       — same place, ``triggered()`` form: the donor
+                         ``os._exit``s hard mid-stream (the SIGKILL/OOM
+                         shape landing exactly between two block frames)
+
   * ``conn_refused``   — worker connect attempt: raises
                          ``ConnectionRefusedError`` (exercises the
                          cluster-formation retry/backoff path; ``times=K``
@@ -84,7 +97,8 @@ from .trace import TRACER
 
 SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
          "replica_raise", "replica_stall", "worker_exit",
-         "conn_refused", "recv_stall", "frame_truncate", "peer_close")
+         "conn_refused", "recv_stall", "frame_truncate", "peer_close",
+         "kvx_stall", "kvx_exit")
 
 
 class FaultError(RuntimeError):
@@ -184,7 +198,8 @@ class FaultRegistry:
             raise ConnectionRefusedError(f"injected {site} (fire #{a.fired})")
         if site.endswith("_raise"):
             raise FaultError(f"injected {site} (fire #{a.fired})")
-        if site in ("step_stall", "recv_stall", "replica_stall"):
+        if site in ("step_stall", "recv_stall", "replica_stall",
+                    "kvx_stall"):
             # block like the real hang: until released or ms elapses
             # (default: effectively forever — the watchdog's / the peer
             # heartbeat timeout's job)

@@ -197,6 +197,75 @@ def estimate_prefix_reuse(
     }
 
 
+def estimate_block_transfer(
+    spec: ModelSpec,
+    *,
+    tokens: int,
+    block_len: int,
+    cache_bytes: float = 2.0,
+    link_gbps: float | None = None,
+    prefill_tok_per_s: float | None = None,
+    mesh=None,
+    q80: bool = False,
+    batch: int = 1,
+) -> dict:
+    """Model one cross-replica KV block transfer (runtime/kv_transfer.py)
+    against the re-prefill it replaces — the "when does a fill pay"
+    arithmetic (docs/serving.md "KV block transfer").
+
+    The WIRE side is exact: ``tokens`` rounds down to whole blocks, each
+    block ships one RMSG_BLOCK_DATA frame of 2 (K and V) * layers *
+    kv_heads * block_len * head_size * cache_bytes payload plus the
+    framed-codec overhead (parallel/multihost.frame_bytes — the same
+    arithmetic the dlwire reconcile tests pin the measured ledger
+    against), bracketed by the HELLO/QUERY/ACK/FETCH/END frames. The
+    REPLACED side is the prefill forward those tokens would have run:
+    per-token collective bytes (estimate_decode_wire — prefill moves the
+    same per-token reduces as decode, batched by segment width) and, when
+    a measured ``prefill_tok_per_s`` is given, the wall time. With a
+    ``link_gbps`` both sides resolve to milliseconds and ``pays`` says
+    whether the transfer wins; without them the byte model stands alone
+    (``pays`` = None — never fabricated).
+
+    ``modeled_data_bytes`` is the exact figure ``reconcile_wire`` closes
+    against the measured BLOCK_DATA ledger entry at the 25% bar."""
+    from ..parallel.multihost import frame_bytes
+
+    bl = int(block_len)
+    n_blocks = max(int(tokens), 0) // bl
+    per_block = int(2 * spec.n_layers * spec.n_kv_heads * bl
+                    * spec.head_size * cache_bytes)
+    data_bytes = n_blocks * frame_bytes(1, per_block)
+    # HELLO [v] + QUERY [requester, n_have, *tokens] + FETCH [s, e] tx;
+    # HELLO_ACK [5] + ACK [7] + END [1] rx — tiny next to the payload,
+    # counted so the model reconciles frame-exactly
+    overhead = (frame_bytes(1, 0) + frame_bytes(2 + int(tokens), 0)
+                + frame_bytes(2, 0) + frame_bytes(5, 0)
+                + frame_bytes(7, 0) + frame_bytes(1, 0))
+    out = {
+        "tokens": n_blocks * bl,
+        "n_blocks": n_blocks,
+        "block_payload_bytes": per_block,
+        "modeled_data_bytes": data_bytes,
+        "overhead_bytes": overhead,
+        "transfer_bytes": data_bytes + overhead,
+        "reprefill_wire_kb": round(
+            estimate_decode_wire(spec, mesh, q80=q80,
+                                 batch=batch).sent_kb_per_token
+            * n_blocks * bl, 3),
+        "transfer_ms": None, "reprefill_ms": None, "pays": None,
+    }
+    if link_gbps:
+        out["transfer_ms"] = round(
+            (data_bytes + overhead) * 8 / (link_gbps * 1e9) * 1e3, 3)
+    if prefill_tok_per_s:
+        out["reprefill_ms"] = round(
+            n_blocks * bl / prefill_tok_per_s * 1e3, 3)
+    if out["transfer_ms"] is not None and out["reprefill_ms"] is not None:
+        out["pays"] = out["transfer_ms"] < out["reprefill_ms"]
+    return out
+
+
 # measured-vs-modeled movement worth flagging, the same 25% bar the
 # autotune knee-drift check uses (tools/dlprof.py mirrors both — it must
 # run with no repo on the path; tests pin the mirrors against each other)

@@ -86,10 +86,16 @@ class _Node:
 
 class PrefixCache:
     def __init__(self, engine, *, num_blocks: int, block_len: int,
-                 stats: PrefixCacheStats | None = None):
+                 stats: PrefixCacheStats | None = None,
+                 transfer: bool = False):
         assert num_blocks >= 1, num_blocks
         assert 1 <= block_len <= engine.seq_len, block_len
         self.engine = engine
+        # cross-replica KV block transfer (runtime/kv_transfer.py): when
+        # armed, warmup() also compiles the block export/import
+        # executables so donor serving and cache fills mint ZERO
+        # post-warmup keys (--freeze-compiles stays green)
+        self.transfer = bool(transfer)
         self.block_len = int(block_len)
         self.num_blocks = int(num_blocks)
         # fixed seed width: ONE compilation key for slot_seed_prefix —
@@ -226,6 +232,91 @@ class PrefixCache:
             if path:
                 self._push_candidate(path[-1])  # the walk's deepest leaf
 
+    # -- cross-replica block transfer (runtime/kv_transfer.py) ------------
+
+    def export_pin(self, tokens: list[int]):
+        """Donor side of a cache FILL: the full whole-block matched path
+        of ``tokens``, PINNED until the caller unpins — eviction must
+        never free a block mid-transfer. Unlike ``lookup_pin`` there is
+        NO len-1 cap: the cap exists so a SEEDING slot's finishing chunk
+        samples real logits, but an exported block only ever reaches a
+        sibling's radix tree, whose own admission lookup re-applies the
+        cap. No hit/tokens_saved stats skew either — a transfer is not
+        an admission. Returns (n_tokens, block_ids, pins)."""
+        self._tick += 1
+        path = self._walk(tokens, min(len(tokens) // self.block_len,
+                                      self.max_seed_blocks))
+        for node in path:
+            node.refs += 1
+            node.last_use = self._tick
+        return (len(path) * self.block_len,
+                [node.block for node in path], tuple(path))
+
+    def export_block_host(self, block_id: int):
+        """Fetch one arena block pair to host numpy — the bytes a
+        BLOCK_DATA frame ships. Must run under the scheduler's step
+        mutex like every arena access: a concurrent publish DONATES the
+        arena arrays (slot_publish_block), so a reference snapshotted
+        outside the mutex could be a deleted buffer by read time."""
+        k, v = self.engine.block_export(self.arena_k, self.arena_v,
+                                        block_id)
+        return np.asarray(k), np.asarray(v)
+
+    def import_path(self, tokens: list[int], start_block: int,
+                    blocks: list) -> int:
+        """Importer side of a cache FILL: attach fetched block pairs
+        ``blocks`` (host (L, KVH, bl, hs) K/V arrays for whole blocks
+        ``start_block..``) under the token path of ``tokens``, writing
+        each NEW block into a freshly allocated arena slot
+        (``Engine.slot_import_block``). Walks existing nodes for free
+        (dedup — a racing local publish wins and the shipped bytes for
+        that index are discarded); stops at the first block the pool
+        cannot serve (dropping the TAIL keeps the tree prefix-closed);
+        returns tokens actually imported. If the parent chain below
+        ``start_block`` broke since the caller measured its own match
+        (local eviction), nothing is attachable prefix-closed and the
+        import aborts to 0 — the admission simply re-prefills.
+
+        The walk path is pinned while importing, same as publish: an
+        allocation's eviction must never take a node the walk stands
+        on."""
+        bl = self.block_len
+        self._tick += 1
+        node = self._root
+        imported = 0
+        end = min(start_block + len(blocks), self.max_seed_blocks,
+                  len(tokens) // bl)
+        path: list[_Node] = []
+        try:
+            for i in range(end):
+                key = tuple(tokens[i * bl: (i + 1) * bl])
+                child = node.children.get(key)
+                if child is None:
+                    if i < start_block:
+                        return 0  # broken parent chain: unattachable
+                    block = self._alloc()
+                    if block is None:
+                        break  # pool full of pinned/live blocks: drop tail
+                    k_np, v_np = blocks[i - start_block]
+                    self.arena_k, self.arena_v = (
+                        self.engine.slot_import_block(
+                            self.arena_k, self.arena_v, k_np, v_np,
+                            block))
+                    child = _Node(key, block, node, epoch=self._epoch)
+                    node.children[key] = child
+                    self.stats.blocks_in_use += 1
+                    imported += 1
+                child.refs += 1
+                path.append(child)
+                child.last_use = self._tick
+                node = child
+        finally:
+            for n in path:
+                n.refs = max(n.refs - 1, 0)
+            if path:
+                self._push_candidate(path[-1])
+        return imported * bl
+
     def _alloc(self) -> int | None:
         if self._free:
             return self._free.pop()
@@ -328,3 +419,14 @@ class PrefixCache:
         if self._free:
             self.arena_k, self.arena_v = self.engine.slot_publish_block(
                 self.arena_k, self.arena_v, 0, 0, self._free[-1])
+        if self.transfer and self._free:
+            # the transfer plane's two executables compile here too (a
+            # fill or a donor query must never mint post-warmup keys):
+            # export reads a FREE block's garbage, import writes it
+            # straight back — state-neutral by the same free-list rule
+            # as the publish warmup above
+            k, v = self.engine.block_export(self.arena_k, self.arena_v,
+                                            self._free[-1])
+            self.arena_k, self.arena_v = self.engine.slot_import_block(
+                self.arena_k, self.arena_v, np.asarray(k), np.asarray(v),
+                self._free[-1])

@@ -64,6 +64,7 @@ import numpy as np
 from ..parallel.multihost import (ClusterProtocolError, _recv_frame,
                                   _send_frame)
 from .faults import FAULTS
+from .kv_transfer import RMSG_BLOCK_QUERY
 from .resilience import EngineUnready
 from .scheduler import (PromptTooLong, QueueFull, RequestError,
                         SchedulerClosed)
@@ -75,8 +76,11 @@ from .trace import TRACER
 # span events back in RMSG_TRACE frames — the version handshake turns a
 # mixed-version parent/worker pair into a clean HELLO failure instead of
 # a misparsed frame. v3: RMSG_PROFILE (on-demand jax.profiler capture,
-# runtime/profiler.py) joined the control verbs.
-REPLICA_PROTOCOL_VERSION = 3
+# runtime/profiler.py) joined the control verbs. v4: the KV block
+# transfer plane (runtime/kv_transfer.py) — RMSG_BLOCK_* verbs, and the
+# submit header grew fill_port/fill_expected (the router's fetch-from-
+# donor instruction) with the ACCEPT echoing the donor's answer.
+REPLICA_PROTOCOL_VERSION = 4
 
 # message kinds — a namespace distinct from the cluster control plane's
 # MSG_* so a replica socket accidentally pointed at a cluster control
@@ -108,10 +112,17 @@ RMSG_PROFILE = 119      # client -> worker (control): [ms] — write one
 #                         into THIS worker's capture dir; RMSG_OK carries
 #                         {dir} back (the /admin/profile relay,
 #                         runtime/profiler.py)
+# 120..124: the KV block transfer verbs (RMSG_BLOCK_QUERY/ACK/FETCH/
+#           DATA/END) — runtime/kv_transfer.py owns them; the server
+#           below dispatches a QUERY-opening connection to BlockDonor
 
 # [max_tokens, temp_bits, topp_bits, rng_lo, rng_hi, vocab, deadline_ms,
-#  n_eos, trace_id] then n_eos stop ids then the prompt
-_SUBMIT_HEADER = 9
+#  n_eos, trace_id, fill_port, fill_expected, fill_donor] then n_eos
+# stop ids then the prompt; the payload carries the fill donor's host
+# (utf-8, empty when fill_port == 0 — no fill requested). fill_donor is
+# the donor's replica id: the importer's wire ledger and kv_fill trace
+# events attribute per donor, not to a constant peer
+_SUBMIT_HEADER = 12
 
 EXIT_WORKER_FAULT = 86   # the worker_exit fault site's os._exit code
 
@@ -160,7 +171,11 @@ class ReplicaServer:
                  port: int = 0, io_timeout: float = 30.0,
                  keepalive: float = 2.0, idle_timeout: float = 600.0,
                  fault_key: str | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 kv_transfer: bool = False, tier: str = "mixed"):
+        from .kv_transfer import BlockDonor
+        from .stats import KVTransferStats
+
         self._factory = sup_factory
         self._io = float(io_timeout)
         self._keepalive = float(keepalive)
@@ -169,6 +184,37 @@ class ReplicaServer:
         self._profile_dir = profile_dir  # RMSG_PROFILE capture home
         self._sup_lock = threading.RLock()
         self.sup = sup_factory()
+        # cross-replica KV block transfer (runtime/kv_transfer.py): this
+        # worker serves sibling QUERY/FETCH connections as a donor and
+        # runs its own fills when a submit carries donor coordinates.
+        # The stats block rides every /stats reply even when disabled
+        # (enabled=False — a tier must not lose the family to a flag);
+        # `tier` is this worker's disaggregation role, advertised on
+        # every PONG so the router places by role.
+        self.tier = tier if tier in ("prefill", "decode", "mixed") \
+            else "mixed"
+        self.kvx_stats = KVTransferStats(enabled=bool(kv_transfer),
+                                         tier=self.tier)
+        self._kv_transfer = bool(kv_transfer)
+        # this worker's replica index (fault_key "rK" -> K): the
+        # requester id its fills stamp on BLOCK_QUERY frames so donors
+        # account wire bytes per peer
+        try:
+            self.replica_index = int((fault_key or "r0").lstrip("r"))
+        except ValueError:
+            self.replica_index = 0
+        pc = self.sup.prefix_cache
+        if pc is not None:
+            from .kv_transfer import block_payload_bytes
+
+            eng = self.sup.engine
+            self.kvx_stats.block_len = pc.block_len
+            self.kvx_stats.block_bytes = block_payload_bytes(
+                eng.spec.n_layers, eng.spec.n_kv_heads, pc.block_len,
+                eng.spec.head_size, eng.cache_dtype)
+        self._donor = BlockDonor(lambda: self.sup, self.kvx_stats,
+                                 fault_key=fault_key,
+                                 io_timeout=self._io)
         # rebuild carry: RMSG_REBUILD swaps the supervisor wholesale, so
         # the dying one's cross-generation totals fold in here and every
         # STATS/PONG reply adds them back — counters never reset or
@@ -242,7 +288,23 @@ class ReplicaServer:
             if frame is None:
                 return
             if frame[0] == RMSG_SUBMIT:
-                self._handle_submit(conn, frame[1])
+                self._handle_submit(conn, frame[1], frame[2])
+            elif frame[0] == RMSG_BLOCK_QUERY:  # donor serving
+                # (runtime/kv_transfer.BlockDonor). A worker with the
+                # transfer plane OFF answers a clean miss instead of
+                # serving: its prefix cache never warmed the export
+                # executable, so serving would mint a post-warmup
+                # compile key (and refuse under --freeze-compiles) —
+                # reachable in mixed --replica-hosts fleets where each
+                # worker's own config decides kv_transfer
+                if self._kv_transfer:
+                    self._donor.serve(conn, frame)
+                else:
+                    from .kv_transfer import RMSG_BLOCK_ACK
+
+                    _send_frame(conn, RMSG_BLOCK_ACK,
+                                [0, 0, 0, 0, 0, 0, 0],
+                                timeout=self._io)
             else:
                 self._control_loop(conn, frame)
         except (OSError, ClusterProtocolError):
@@ -253,13 +315,15 @@ class ReplicaServer:
             except OSError:
                 pass
 
-    def _handle_submit(self, conn: socket.socket, ints: list[int]) -> None:
+    def _handle_submit(self, conn: socket.socket, ints: list[int],
+                       payload: bytes = b"") -> None:
         from ..sampler import Sampler
 
         if len(ints) < _SUBMIT_HEADER:
             raise ClusterProtocolError(f"short submit header: {len(ints)}")
         (max_tokens, temp_b, topp_b, rng_lo, rng_hi, vocab,
-         deadline_ms, n_eos, trace_id) = ints[:_SUBMIT_HEADER]
+         deadline_ms, n_eos, trace_id, fill_port,
+         fill_expected, fill_donor) = ints[:_SUBMIT_HEADER]
         eos = [int(t) for t in ints[_SUBMIT_HEADER:_SUBMIT_HEADER + n_eos]]
         prompt = [int(t) for t in ints[_SUBMIT_HEADER + n_eos:]]
         sampler = Sampler(int(vocab), temperature=_bits_f32(temp_b),
@@ -272,6 +336,43 @@ class ReplicaServer:
                     else time.perf_counter() + deadline_ms / 1e3)
         with self._sup_lock:
             sup = self.sup
+        # cache FILL on miss (runtime/kv_transfer.py): the router knows a
+        # sibling holds a longer prefix than this replica — fetch its
+        # blocks into the local radix tree BEFORE admission, so the
+        # ordinary _admit seeds them and only the uncached suffix
+        # prefills. Degrades to a plain re-prefill on ANY failure; the
+        # donor's answer rides the ACCEPT frame back so the router can
+        # clear stale shadow entries (a QUERY miss == donor eviction).
+        fill_answer = -1
+        # the transfer's budget is bounded by the REQUEST's remaining
+        # budget, not just the io timeout: a wedged donor must degrade
+        # to a re-prefill with time left to actually serve — a fill
+        # that eats the whole deadline would convert a transfer failure
+        # into the client-visible request failure the degrade contract
+        # forbids (half the budget for the fill, floor 0.25 s to skip
+        # hopeless attempts)
+        fill_budget = min(self._io, 15.0)
+        if deadline_ms >= 0:
+            fill_budget = min(fill_budget, deadline_ms / 1e3 * 0.5)
+        if fill_port > 0 and self._kv_transfer and fill_budget >= 0.25:
+            from .kv_transfer import fill_from_wire
+
+            host = (payload.decode("utf-8", errors="replace")
+                    if payload else "127.0.0.1")
+            try:
+                sched = sup._sched
+            except AttributeError:
+                sched = None
+            if sched is not None:
+                fill_answer = fill_from_wire(
+                    sched, prompt, host, int(fill_port),
+                    int(fill_expected), stats=self.kvx_stats,
+                    protocol_version=REPLICA_PROTOCOL_VERSION,
+                    trace_id=int(trace_id),
+                    requester=self.replica_index,
+                    donor_peer=int(fill_donor),
+                    io_timeout=min(self._io, 10.0),
+                    deadline_s=fill_budget)
         try:
             # the PARENT minted the trace id: worker-side scheduler events
             # carry it so the shipped span merges onto the parent's
@@ -303,7 +404,12 @@ class ReplicaServer:
         wsock = conn.dup()
         done = threading.Event()
         try:
-            _send_frame(wsock, RMSG_ACCEPT, [req.id], timeout=self._io)
+            # the ACCEPT echoes the fill verdict (donor's answered match
+            # in tokens; -1 = no verdict) + what the router expected —
+            # the shadow-staleness feedback channel, no extra RPC
+            _send_frame(wsock, RMSG_ACCEPT,
+                        [req.id, fill_answer, int(fill_expected)],
+                        timeout=self._io)
             threading.Thread(target=self._cancel_watcher,
                              args=(conn, req, done), daemon=True).start()
             self._pump(wsock, req)
@@ -463,6 +569,9 @@ class ReplicaServer:
         return {"state": sup.state, "ready": sup.ready, "load": load,
                 "busy": load > 0,
                 "recoveries": sup.sup_stats.recoveries,
+                # the disaggregation role — connect-mode routers learn it
+                # from here (spawn mode ships it in the worker config)
+                "tier": self.tier,
                 "counters": counters}
 
     def _summary(self) -> dict:
@@ -473,6 +582,10 @@ class ReplicaServer:
         for k in _COUNTER_KEYS:
             out[k] = out.get(k, 0) + carry[k]
         out["pid"] = os.getpid()
+        # this worker's transfer-plane record (donor serving + its own
+        # fills) — present even when transfer is off (enabled=False)
+        out["kv_transfer"] = self.kvx_stats.summary()
+        out["tier"] = self.tier
         if TRACER.enabled:
             # the step timeline is WORKER-local (the parent never sees
             # our iterations) — ride it on the stats reply so the bench
@@ -578,6 +691,10 @@ def build_supervisor_factory(cfg: dict):
         stall_timeout=serve.get("stall_timeout") or 10.0,
         prefix_blocks=n_blocks,
         prefix_block_len=int(cfg.get("prefix_block_len", 32)),
+        # KV block transfer (runtime/kv_transfer.py): arms the prefix
+        # cache's export/import warmup so fills/donor serving mint zero
+        # post-warmup compile keys
+        kv_transfer=bool(cfg.get("kv_transfer")),
         fault_key=cfg.get("fault_key"),
         # SLO-aware admission runs INSIDE each worker (the policy reads
         # the worker's own step timeline; its block rides the stats
@@ -611,6 +728,10 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
         "prefix_blocks": int(getattr(args, "prefix_blocks", 0) or 0),
         "prefix_block_len": int(getattr(args, "prefix_block_len", None)
                                 or 32),
+        # KV block transfer (runtime/kv_transfer.py): the enable flag
+        # ships; the per-replica `tier` role is stamped per index by
+        # build_front_door, like fault_key
+        "kv_transfer": bool(getattr(args, "kv_transfer", False)),
         # speculative decoding (runtime/draft.py): the draft SPEC ships
         # (the worker builds the DraftModel over its own engine);
         # draft_vocab is filled in by the api server once the tokenizer
@@ -705,7 +826,9 @@ def main(argv: list[str] | None = None) -> int:
                            io_timeout=args.io_timeout,
                            keepalive=args.keepalive,
                            fault_key=cfg.get("fault_key"),
-                           profile_dir=profile_dir)
+                           profile_dir=profile_dir,
+                           kv_transfer=bool(cfg.get("kv_transfer")),
+                           tier=cfg.get("tier") or "mixed")
     port = server.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
@@ -781,6 +904,13 @@ class _RemoteStream:
         self.finish_reason: str | None = None
         self.stats = RequestStats(n_prompt=n_prompt)
         self.stats.t_submit = time.perf_counter()
+        # the ACCEPT frame's fill verdict (runtime/kv_transfer.py): the
+        # donor's answered match in tokens (-1 = no fill / no verdict)
+        # and what the router expected off its shadow index — the
+        # router reads these right after submit to clear stale shadow
+        # entries (a miss answer == donor-side eviction)
+        self.fill_answer = -1
+        self.fill_expected = 0
 
     def cancel(self) -> None:
         try:
@@ -939,31 +1069,42 @@ class WorkerClient:
             raise
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None, trace_id: int = 0) -> _RemoteStream:
+               deadline=None, trace_id: int = 0,
+               fill: tuple | None = None) -> _RemoteStream:
         """Place one request on the worker. Door refusals re-raise the
         SAME exception types the in-process supervisor uses (QueueFull /
         EngineUnready / PromptTooLong / SchedulerClosed), so the router's
         walk-past-refusals placement loop needs no remote special case; a
         worker that cannot even be reached is an EngineUnready door
         refusal too (the process is dead or respawning — its monitor
-        will say so shortly)."""
+        will say so shortly).
+
+        ``fill`` = (donor_host, donor_port, expected_tokens, donor_id)
+        instructs the worker to fetch the donor's published KV blocks
+        before admission (runtime/kv_transfer.py); the ACCEPT's fill
+        verdict lands on the returned stream."""
         prompt = [int(t) for t in prompt]
         eos = ([eos_id] if isinstance(eos_id, int)
                else sorted(int(t) for t in (eos_id or ())))
         deadline_ms = (-1 if deadline is None else
                        max(int((deadline - time.perf_counter()) * 1e3), 0))
+        fill_host, fill_port, fill_expected, fill_donor = (
+            fill or ("", 0, 0, 0))
         rng = sampler.rng_state
         ints = [int(max_tokens), _f32_bits(sampler.temperature),
                 _f32_bits(sampler.topp), rng & 0xFFFFFFFF,
                 (rng >> 32) & 0xFFFFFFFF, sampler.vocab_size,
-                deadline_ms, len(eos), int(trace_id), *eos, *prompt]
+                deadline_ms, len(eos), int(trace_id), int(fill_port),
+                int(fill_expected), int(fill_donor), *eos, *prompt]
         try:
             sock = self._connect()
         except (OSError, ClusterProtocolError) as e:
             raise EngineUnready(f"unreachable ({type(e).__name__})",
                                 1.0) from e
         try:
-            _send_frame(sock, RMSG_SUBMIT, ints, timeout=self._io)
+            _send_frame(sock, RMSG_SUBMIT, ints,
+                        payload=fill_host.encode("utf-8"),
+                        timeout=self._io)
             frame = _recv_frame(sock, timeout=self._io)
         except (OSError, ClusterProtocolError) as e:
             sock.close()
@@ -992,6 +1133,10 @@ class WorkerClient:
                            int(frame[1][0]) if frame[1] else 0,
                            trace_id=int(trace_id),
                            origin=f"worker@{self.addr[0]}:{self.addr[1]}")
+        if len(frame[1]) >= 3:
+            # the fill verdict the ACCEPT echoed (see _handle_submit)
+            rs.fill_answer = int(frame[1][1])
+            rs.fill_expected = int(frame[1][2])
         self.stats.requests.append(rs.stats)
         return rs
 

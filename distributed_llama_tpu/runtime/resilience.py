@@ -85,6 +85,7 @@ class EngineSupervisor:
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
                  breaker_threshold: int = 3,
                  prefix_blocks: int = 0, prefix_block_len: int = 32,
+                 kv_transfer: bool = False,
                  fault_key: str | None = None,
                  slo_ttft_ms: float | None = None,
                  slo_itl_ms: float | None = None,
@@ -105,6 +106,10 @@ class EngineSupervisor:
         # Scheduler._abort_all invalidate on the dying generation).
         self._prefix_blocks = int(prefix_blocks)
         self._prefix_block_len = int(prefix_block_len)
+        # cross-replica KV block transfer (runtime/kv_transfer.py): arms
+        # the per-generation prefix cache's export/import warmup so
+        # fills and donor serving mint ZERO post-warmup compile keys
+        self._kv_transfer = bool(kv_transfer)
         # SLO targets for the adaptive admission policy — every rebuilt
         # generation's scheduler gets a FRESH policy (its EWMAs describe
         # the dead engine's steps; the new one re-learns in a few steps)
@@ -357,7 +362,8 @@ class EngineSupervisor:
             from .prefix_cache import PrefixCache
 
             pc = PrefixCache(engine, num_blocks=self._prefix_blocks,
-                             block_len=self._prefix_block_len)
+                             block_len=self._prefix_block_len,
+                             transfer=self._kv_transfer)
         draft_factory = None
         if self._draft:
             from .draft import build_draft

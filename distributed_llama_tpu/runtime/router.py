@@ -86,8 +86,17 @@ class ReplicaHandle:
 
     has_local_engine = True  # Router.exclusive may borrow our engine
 
-    def __init__(self, rid: int, engine_factory, sup_kwargs: dict):
+    def __init__(self, rid: int, engine_factory, sup_kwargs: dict,
+                 tier: str = "mixed"):
         self.id = rid
+        # disaggregation role (runtime/kv_transfer.py): "prefill" keeps
+        # this replica OUT of request placement — it only runs the
+        # router's prefill passes and donates blocks; "decode"/"mixed"
+        # serve requests (decode == mixed for a thread replica: the
+        # role's value is that the ROUTER never places prefill-heavy
+        # passes on it)
+        self.tier = tier if tier in ("prefill", "decode", "mixed") \
+            else "mixed"
         self._factory = engine_factory
         self._sup_kwargs = dict(sup_kwargs)
         self.sup = EngineSupervisor(engine_factory,
@@ -189,6 +198,7 @@ class ReplicaHandle:
         for k in _COUNTER_KEYS:
             s[k] = (s.get(k) or 0) + self._carry[k]
         s["replica"] = self.id
+        s["tier"] = self.tier
         s["draining"] = self.draining
         s["breaker_open"] = self.open_until > 0.0
         return s
@@ -242,6 +252,27 @@ class ShadowPrefixIndex:
                     break
                 n = i
         return n * self.block_len
+
+    def truncate(self, tokens: list[int], keep_tokens: int) -> int:
+        """Drop the shadowed paths of ``tokens`` BEYOND ``keep_tokens``
+        — the shadow-staleness fix (runtime/kv_transfer.py): a donor's
+        RMSG_BLOCK_QUERY answered with less than this shadow promised,
+        which means the worker EVICTED part of the path the shadow still
+        advertises. Left alone, the stale entries would keep attracting
+        placements and fetches of dead blocks; the miss answer is the
+        ground truth, so the entries past it go. Returns entries
+        dropped."""
+        usable = max(len(tokens) - 1, 0) // self.block_len
+        dropped = 0
+        missing = object()  # stored values are None — a None pop result
+        # cannot distinguish hit from miss
+        with self._lock:
+            for i in range(max(keep_tokens, 0) // self.block_len + 1,
+                           usable + 1):
+                if self._paths.pop(tuple(tokens[: i * self.block_len]),
+                                   missing) is not missing:
+                    dropped += 1
+        return dropped
 
     def clear(self) -> None:
         with self._lock:
@@ -308,13 +339,19 @@ class RemoteReplicaHandle:
                  spawn_timeout: float = 180.0, respawn_timeout: float = 180.0,
                  spawn_backoff_base: float = 0.2,
                  spawn_backoff_max: float = 5.0, spawn_breaker: int = 3,
-                 min_uptime: float = 5.0):
+                 min_uptime: float = 5.0, tier: str = "mixed"):
         from .replica_worker import WorkerClient
         from .stats import ProcStats
 
         assert (proc is None) != (address is None), \
             "exactly one of proc (local spawn) or address (connect)"
         self.id = rid
+        # disaggregation role: spawn mode stamps it from the shipped
+        # worker config; connect mode starts at the default and adopts
+        # whatever the worker's PONG advertises (pre-started workers own
+        # their configs — _refresh_health below)
+        self.tier = tier if tier in ("prefill", "decode", "mixed") \
+            else "mixed"
         self.sup = self
         self.draining = False
         self.fails = 0
@@ -384,7 +421,7 @@ class RemoteReplicaHandle:
         return _RemoteEngineInfo(self.client)
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None, trace_id=None):
+               deadline=None, trace_id=None, fill=None):
         if self._broken or self._closed:
             raise EngineUnready(self.state, self._retry_after())
         if not self._health.get("ready"):
@@ -394,7 +431,7 @@ class RemoteReplicaHandle:
             raise EngineUnready(self.state, self._retry_after())
         return self.client.submit(prompt, max_tokens, sampler,
                                   eos_id=eos_id, deadline=deadline,
-                                  trace_id=trace_id or 0)
+                                  trace_id=trace_id or 0, fill=fill)
 
     def exclusive(self):
         raise EngineUnready("remote replica: no borrowable local engine",
@@ -515,6 +552,7 @@ class RemoteReplicaHandle:
                 base[k] = (base.get(k) or 0) + self._carry[k]
         base["state"] = self.state
         base["replica"] = self.id
+        base["tier"] = self.tier
         base["draining"] = self.draining
         base["breaker_open"] = self.open_until > 0.0
         proc = self.proc_stats.summary()
@@ -548,6 +586,10 @@ class RemoteReplicaHandle:
                 self.shadow.clear()
             self._last_counters = payload.get("counters",
                                               self._last_counters)
+            if payload.get("tier") in ("prefill", "decode", "mixed"):
+                # connect-mode workers own their configs: the PONG is
+                # where the router learns (and tracks) their role
+                self.tier = payload["tier"]
             self._health = payload
 
     def _monitor(self) -> None:
@@ -816,10 +858,29 @@ class Router:
     def __init__(self, engine_factory, *, replicas: int = 2,
                  policy: str = "cache_aware", retry_budget: int = 1,
                  circuit_threshold: int = 3, circuit_cooldown: float = 5.0,
-                 handle_factories=None, **sup_kwargs):
+                 handle_factories=None, kv_transfer: bool = False,
+                 fill_min_tokens: int = 32, tiers=None, **sup_kwargs):
         # circuit_* name the ROUTER-level breaker so the supervisor's own
         # breaker_threshold still rides **sup_kwargs without a collision
         assert policy in POLICIES, policy
+        from .stats import KVTransferStats
+
+        # cross-replica KV block transfer (runtime/kv_transfer.py): when
+        # armed, placement also decides FILLS (the placed replica fetches
+        # a warmer sibling's blocks instead of re-prefilling) and runs
+        # the prefill/decode disaggregation (prefill-tier replicas take
+        # the prompt pass, decode-tier replicas admit already-seeded).
+        # fill_min_tokens (default: one block) is the minimum cache
+        # advantage worth a transfer.
+        self._kv_transfer = bool(kv_transfer)
+        self._fill_min = max(int(fill_min_tokens), 1)
+        self.kvx = KVTransferStats(enabled=self._kv_transfer,
+                                   tier="router",
+                                   block_len=int(fill_min_tokens))
+        # thread replicas' supervisors arm the prefix cache's transfer
+        # warmup off the ROUTER's flag (the router owns it — one home,
+        # so build_front_door cannot pass it twice)
+        sup_kwargs = dict(sup_kwargs, kv_transfer=self._kv_transfer)
         if handle_factories is not None:
             # PROCESS/REMOTE tier: the caller supplies zero-arg factories
             # building RemoteReplicaHandles (build_front_door's
@@ -852,7 +913,9 @@ class Router:
             else:
                 for i in range(replicas):
                     self.replicas.append(
-                        ReplicaHandle(i, engine_factory, sup_kwargs))
+                        ReplicaHandle(i, engine_factory, sup_kwargs,
+                                      tier=(tiers[i] if tiers
+                                            else "mixed")))
         except BaseException:
             # replica K failed to build (e.g. the K+1-th KV cache/arena
             # OOMs): close the K already-running supervisors — their step
@@ -926,6 +989,13 @@ class Router:
         tid = TRACER.new_id() if TRACER.enabled else 0
         req = RouterRequest(self, [int(t) for t in prompt], max_tokens,
                             eos_id, deadline, spec, session, trace_id=tid)
+        if self._kv_transfer:
+            # prefill/decode disaggregation: run the prompt through a
+            # prefill-tier replica first (publishes its blocks), so the
+            # decode placement below admits already-seeded via a fill
+            # from that donor. No prefill worker routable -> the mixed
+            # path below serves unchanged.
+            self._prefill_pass(req)
         self._place(req, exclude=(), sampler=sampler)
         return req
 
@@ -1006,8 +1076,17 @@ class Router:
         # would multi-count the one weight allocation (docs/
         # observability.md "Device tier").
         from .profiler import COMPILES
+        from .stats import KVTransferStats
 
         out["compiles"] = COMPILES.summary()
+        # the transfer-plane aggregate: the router's own record (thread-
+        # tier fills, disaggregation decisions, shadow fixes) + every
+        # worker's wire record — present even with transfer off
+        # (enabled=False: a tier must not lose the family to a flag)
+        out["kv_transfer"] = KVTransferStats.merge(
+            [self.kvx.summary()]
+            + [r.get("kv_transfer") for r in reps
+               if isinstance(r.get("kv_transfer"), dict)])
         return out
 
     def _retry_after(self) -> float:
@@ -1080,9 +1159,15 @@ class Router:
     # -- placement ---------------------------------------------------------
 
     def _routable(self, h: ReplicaHandle, now: float) -> bool:
-        """May traffic go to h right now? Supervisor-ready AND not
-        draining AND the router circuit allows it (closed, or half-open
-        with no probe already in flight). Caller holds the lock."""
+        """May REQUEST traffic go to h right now? Supervisor-ready AND
+        not draining AND the router circuit allows it (closed, or
+        half-open with no probe already in flight). Prefill-TIER
+        replicas are never request-routable: they exist to run prefill
+        passes and donate blocks (runtime/kv_transfer.py) — a tier of
+        only prefill workers is therefore correctly unready. Caller
+        holds the lock."""
+        if getattr(h, "tier", "mixed") == "prefill":
+            return False
         if h.draining or h.sup is None or not h.sup.ready:
             return False
         if h.open_until <= 0.0:
@@ -1130,6 +1215,110 @@ class Router:
             h = min(cands, key=lambda h: (h.load(), h.id))
             return (h, "fallback", self._mark_probe(h, now))
 
+    # -- KV block transfer: fills + disaggregation (kv_transfer.py) --------
+
+    def _pick_donor(self, target, prompt: list[int]):
+        """The fill decision: the sibling whose cache (real radix tree
+        for thread replicas, shadow index for process replicas) leads
+        the TARGET's by at least one whole block's worth of tokens.
+        Returns (donor_handle, donor_match_tokens) or None. Lock-free
+        peeks, same discipline as cache-aware _pick — a transiently
+        stale answer costs one useless fetch (which degrades to a
+        re-prefill), never correctness."""
+        have = target.match_len(prompt)
+        best, best_n = None, have + self._fill_min - 1
+        for h in self.replicas:
+            if h.id == target.id or h.draining or h.sup is None:
+                continue
+            if not h.sup.ready:
+                continue  # a dead/respawning donor cannot serve a fetch
+            n = h.match_len(prompt)
+            if n > best_n:
+                best, best_n = h, n
+        return (best, best_n) if best is not None else None
+
+    def _prefill_pass(self, req: "RouterRequest") -> None:
+        """Run req's prompt through a prefill-tier replica with
+        max_tokens=0: the full prompt prefills there (big chunks, no
+        decode rows to interfere with) and its whole blocks publish at
+        prefill-finish — the donor the decode placement's fill then
+        draws from. Every failure shape (no routable prefill worker,
+        door refusal, worker death) falls back to the unified mixed
+        path; the pass must never fail the request."""
+        if len(req._prompt) <= self._fill_min:
+            return  # nothing a whole-block handoff could carry
+        now = time.perf_counter()
+        with self._lock:
+            cands = [h for h in self.replicas
+                     if getattr(h, "tier", "mixed") == "prefill"
+                     and not h.draining and h.sup is not None
+                     and (h.open_until <= 0.0 or now >= h.open_until)]
+        cands = [h for h in cands if h.sup.ready]
+        if not cands:
+            if any(getattr(h, "tier", "mixed") == "prefill"
+                   for h in self.replicas):
+                with self._lock:
+                    self.kvx.prefill_pass_fallbacks += 1
+            return
+        h = min(cands, key=lambda h: (h.load(), h.id))
+        t0 = time.perf_counter()
+        try:
+            inner = h.sup.submit(req._prompt, 0, req._fresh_sampler(),
+                                 eos_id=req._eos_id,
+                                 deadline=req._deadline,
+                                 trace_id=req.trace_id)
+            for _ in inner.tokens(timeout=60.0):
+                pass  # max_tokens=0: prefill only, nothing streams
+            h.note_routed(req._prompt)
+            with self._lock:
+                self.kvx.prefill_passes += 1
+            if TRACER.enabled:
+                TRACER.event("route", req.trace_id, replica=h.id,
+                             reason="prefill_pass",
+                             ms=round((time.perf_counter() - t0) * 1e3,
+                                      3))
+        except Exception:  # noqa: BLE001 — degrade to the mixed path
+            with self._lock:
+                self.kvx.prefill_pass_fallbacks += 1
+
+    def _arrange_fill(self, h, req: "RouterRequest", sampler_unused=None):
+        """Pre-submit fill work for a placement on h. Returns the
+        ``fill`` tuple to ride a REMOTE submit frame (the worker fetches
+        donor->self over the wire), or None. Thread-tier fills run right
+        here (donor and target share this process)."""
+        donor = self._pick_donor(h, req._prompt)
+        if donor is None:
+            return None
+        dh, dn = donor
+        remote_t = hasattr(h, "client")
+        remote_d = hasattr(dh, "client")
+        if remote_t and remote_d:
+            addr = dh.client.addr
+            return (addr[0], addr[1], dn, dh)
+        if not remote_t and not remote_d:
+            from .kv_transfer import local_fill
+
+            local_fill(dh.sup, h.sup, req._prompt, stats=self.kvx,
+                       trace_id=req.trace_id, donor_id=dh.id)
+            # thread replicas peek the REAL tree — no shadow to go stale
+        return None
+
+    def _note_fill_verdict(self, donor_handle, req: "RouterRequest",
+                           inner, expected: int) -> None:
+        """The shadow-staleness fix: the worker's ACCEPT echoed what the
+        donor's RMSG_BLOCK_QUERY actually answered. An answer SHORT of
+        what the shadow promised means donor-side eviction — drop the
+        stale entries so they stop attracting placements and fetches of
+        dead blocks (-1 = no verdict: donor unreachable, maybe
+        mid-respawn — its monitor clears the shadow on its own)."""
+        ans = getattr(inner, "fill_answer", -1)
+        if ans < 0 or ans >= expected:
+            return
+        shadow = getattr(donor_handle, "shadow", None)
+        if shadow is not None and shadow.truncate(req._prompt, ans):
+            with self._lock:
+                self.kvx.shadow_truncates += 1
+
     def _mark_probe(self, h: ReplicaHandle, now: float) -> bool:
         """Arm the half-open probe if this pick crossed the cooldown.
         Returns True iff THIS pick is the probe (the caller must release
@@ -1165,11 +1354,28 @@ class Router:
                 if isinstance(last_exc, (QueueFull, EngineUnready)):
                     raise last_exc from None
                 raise
+            # cache FILL on miss (runtime/kv_transfer.py): when a warmer
+            # sibling exists, thread tiers import its blocks right here;
+            # process tiers ship the donor's coordinates on the submit
+            # frame and the worker pulls donor->self directly
+            fill = (self._arrange_fill(h, req) if self._kv_transfer
+                    else None)
             try:
-                inner = h.sup.submit(req._prompt, req._max_tokens, sampler,
-                                     eos_id=req._eos_id,
-                                     deadline=req._deadline,
-                                     trace_id=req.trace_id)
+                if fill is not None:
+                    d_host, d_port, d_expected, d_handle = fill
+                    inner = h.sup.submit(req._prompt, req._max_tokens,
+                                         sampler, eos_id=req._eos_id,
+                                         deadline=req._deadline,
+                                         trace_id=req.trace_id,
+                                         fill=(d_host, d_port,
+                                               d_expected, d_handle.id))
+                    self._note_fill_verdict(d_handle, req, inner,
+                                            d_expected)
+                else:
+                    inner = h.sup.submit(req._prompt, req._max_tokens,
+                                         sampler, eos_id=req._eos_id,
+                                         deadline=req._deadline,
+                                         trace_id=req.trace_id)
             except (EngineUnready, QueueFull, SchedulerClosed) as e:
                 if probe:
                     self._release_probe(h)
@@ -1258,7 +1464,8 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                      slo_ttft_ms: float | None = None,
                      slo_itl_ms: float | None = None,
                      draft: str | None = None, draft_len: int = 0,
-                     draft_vocab: int | None = None):
+                     draft_vocab: int | None = None,
+                     kv_transfer: bool = False, tiers=None):
     """The ONE constructor of the serving front door, shared by every
     deployment shape (the engine-owner logic that used to live in
     apps/api_server.ApiState.scheduler):
@@ -1298,15 +1505,21 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                 # into the worker so DLLAMA_FAULTS key=rK follows replica
                 # K across respawns, same as the thread tier
                 cfg["fault_key"] = f"r{i}"
+                # per-replica disaggregation role + transfer arming
+                # (runtime/kv_transfer.py) — stamped like fault_key so
+                # the role survives respawns
+                cfg["kv_transfer"] = bool(kv_transfer)
+                tier = tiers[i] if tiers else "mixed"
+                cfg["tier"] = tier
 
-                def make(i=i, cfg=cfg):
+                def make(i=i, cfg=cfg, tier=tier):
                     proc = WorkerProc(i, cfg, workdir=workdir,
                                       io_timeout=worker_io_timeout)
                     return RemoteReplicaHandle(
                         i, proc=proc, block_len=prefix_block_len,
                         io_timeout=worker_io_timeout,
                         spawn_timeout=spawn_timeout,
-                        respawn_timeout=spawn_timeout)
+                        respawn_timeout=spawn_timeout, tier=tier)
                 factories.append(make)
         else:
             for i, (host, port) in enumerate(replica_hosts):
@@ -1319,6 +1532,8 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         return Router(None, policy=route_policy,
                       retry_budget=retry_budget,
                       handle_factories=factories,
+                      kv_transfer=kv_transfer,
+                      fill_min_tokens=prefix_block_len,
                       request_deadline=request_deadline or None)
 
     def engine_factory():
@@ -1344,7 +1559,10 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
         draft=draft, draft_len=draft_len, draft_vocab=draft_vocab)
     if replicas <= 1:
-        return EngineSupervisor(engine_factory, **sup_kwargs)
+        return EngineSupervisor(engine_factory, kv_transfer=kv_transfer,
+                                **sup_kwargs)
     return Router(engine_factory, replicas=replicas,
                   policy=route_policy, retry_budget=retry_budget,
+                  kv_transfer=kv_transfer,
+                  fill_min_tokens=prefix_block_len, tiers=tiers,
                   **sup_kwargs)

@@ -1147,6 +1147,53 @@ class Scheduler:
                 pass
             yield self.engine
 
+    # -- cross-replica KV block transfer (runtime/kv_transfer.py) ----------
+    #
+    # The admit-seeded-from-transfer path needs NO new admission code: a
+    # fill publishes the fetched blocks into THIS scheduler's radix tree
+    # before submit, and _admit's ordinary lookup_pin then seeds them —
+    # so the PR-4 invariant (seeded K/V == a cold prefill's writes, greedy
+    # bit-identical) carries over unchanged: the shipped bytes ARE a
+    # prefill's writes, just a sibling replica's. These helpers exist so
+    # the transfer engine never reaches into the step mutex directly.
+
+    def kv_match_len(self, tokens: list[int]) -> int:
+        """Lock-free peek at this scheduler's cached prefix (0 with the
+        cache off) — the importer's n_have before deciding a fetch."""
+        pc = self.prefix_cache
+        return pc.match_len(tokens) if pc is not None else 0
+
+    def kv_export_pin(self, tokens: list[int]):
+        """Donor: pin + describe the exportable path (under the step
+        mutex). Returns (n_tokens, block_ids, pins); (0, [], ()) with
+        the cache off."""
+        if self.prefix_cache is None:
+            return 0, [], ()
+        with self._mutex:
+            return self.prefix_cache.export_pin(tokens)
+
+    def kv_export_block(self, block_id: int):
+        """Donor: one pinned block's host K/V pair (under the step mutex
+        — see PrefixCache.export_block_host for why)."""
+        with self._mutex:
+            return self.prefix_cache.export_block_host(block_id)
+
+    def kv_unpin(self, pins) -> None:
+        with self._mutex:
+            if self.prefix_cache is not None:
+                self.prefix_cache.unpin(pins)
+
+    def kv_import_prefix(self, tokens: list[int], start_block: int,
+                         blocks: list) -> int:
+        """Importer: publish fetched blocks into this scheduler's tree
+        (under the step mutex). Returns tokens imported (0 = nothing
+        attachable: the next admission simply re-prefills)."""
+        if self.prefix_cache is None:
+            return 0
+        with self._mutex:
+            return self.prefix_cache.import_path(tokens, start_block,
+                                                 blocks)
+
     # -- observability -----------------------------------------------------
 
     def wire_estimate(self):

@@ -485,6 +485,126 @@ class ClusterStats:
 
 
 @dataclasses.dataclass
+class KVTransferStats:
+    """Counters for the cross-replica KV block transfer plane
+    (runtime/kv_transfer.py): cache FILLs on miss (a replica imports a
+    sibling's published arena blocks instead of re-prefilling), the
+    donor-side export serving, and the router's prefill/decode
+    disaggregation handoffs. Owned by the party that does the work —
+    the Router for thread-tier fills + disaggregation decisions, each
+    worker's ReplicaServer for its own wire serving/fills — and surfaced
+    as the ``kv_transfer`` /stats block + the ``dllama_kv_transfer_*``
+    /metrics family in EVERY tier incl. idle (enabled=False, zeros:
+    a tier must never lose a metric family to a launch flag).
+
+    ``wire`` is a :class:`WireStats` ledger accounting the RMSG_BLOCK_*
+    frames per (peer, kind, dir) — the same measured-bytes discipline as
+    the cluster control plane (dlwire), so ``netstats.reconcile_wire``
+    can close measured-vs-modeled over block transfers too."""
+
+    enabled: bool = False
+    tier: str = "mixed"        # this party's role: prefill|decode|mixed
+    block_len: int = 0
+    block_bytes: int = 0       # one block's K+V payload bytes (exact)
+    # importer side (cache FILL on miss)
+    fills_requested: int = 0   # fill decisions / attempts
+    fills_ok: int = 0          # >= 1 block actually imported
+    fill_fallbacks: int = 0    # error/timeout/donor death -> re-prefill
+    fill_misses: int = 0       # donor answered shorter than expected
+    tokens_filled: int = 0     # prompt tokens imported instead of prefilled
+    blocks_filled: int = 0
+    bytes_rx: int = 0          # block payload bytes received
+    # donor side (export serving)
+    queries_served: int = 0
+    query_misses: int = 0      # QUERY answered with nothing fetchable
+    blocks_exported: int = 0
+    bytes_tx: int = 0          # block payload bytes sent
+    donor_aborts: int = 0      # exports cut short (peer death, error)
+    # prefill/decode disaggregation (router-side)
+    prefill_passes: int = 0          # prefill-tier passes completed
+    prefill_pass_fallbacks: int = 0  # no prefill worker / pass failed ->
+    #                                  unified mixed path
+    shadow_truncates: int = 0        # stale shadow entries cleared by a
+    #                                  QUERY miss answer (donor eviction)
+
+    def __post_init__(self):
+        import threading
+        from collections import deque
+
+        # whole-fill wall ms (connect -> last block imported)
+        self.transfer_ms = deque(maxlen=1000)
+        self.wire = WireStats()
+        # counter mutations ride this lock (concurrent fills/donor
+        # connections all write here; += on a dataclass int is a
+        # read-modify-write that can drop counts under contention —
+        # the same discipline RouterStats keeps via the router lock)
+        self.lock = threading.Lock()
+
+    def note_transfer_ms(self, ms: float) -> None:
+        with self.lock:
+            self.transfer_ms.append(float(ms))
+
+    def summary(self) -> dict:
+        rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+        xs = list(self.transfer_ms)
+        out = {
+            "enabled": self.enabled,
+            "tier": self.tier,
+            "block_len": self.block_len,
+            "block_bytes": self.block_bytes,
+            "fills_requested": self.fills_requested,
+            "fills_ok": self.fills_ok,
+            "fill_fallbacks": self.fill_fallbacks,
+            "fill_misses": self.fill_misses,
+            "tokens_filled": self.tokens_filled,
+            "blocks_filled": self.blocks_filled,
+            "bytes_rx": self.bytes_rx,
+            "queries_served": self.queries_served,
+            "query_misses": self.query_misses,
+            "blocks_exported": self.blocks_exported,
+            "bytes_tx": self.bytes_tx,
+            "donor_aborts": self.donor_aborts,
+            "prefill_passes": self.prefill_passes,
+            "prefill_pass_fallbacks": self.prefill_pass_fallbacks,
+            "shadow_truncates": self.shadow_truncates,
+            "transfer_p50_ms": rnd(percentile(xs, 50)),
+            "transfer_p99_ms": rnd(percentile(xs, 99)),
+        }
+        wire = self.wire.summary()
+        if wire.get("tx_bytes") or wire.get("rx_bytes"):
+            out["wire"] = wire
+        return out
+
+    @staticmethod
+    def merge(blocks: list) -> dict:
+        """Sum a list of summary() dicts into one aggregate (the router's
+        top-level block over its own counters + every worker's). Counters
+        add; enabled/tier describe the aggregate; percentiles are not
+        mergeable and report None unless exactly one side has them."""
+        keys = ("fills_requested", "fills_ok", "fill_fallbacks",
+                "fill_misses", "tokens_filled", "blocks_filled",
+                "bytes_rx", "queries_served", "query_misses",
+                "blocks_exported", "bytes_tx", "donor_aborts",
+                "prefill_passes", "prefill_pass_fallbacks",
+                "shadow_truncates")
+        blocks = [b for b in blocks if isinstance(b, dict)]
+        out = {k: sum(int(b.get(k) or 0) for b in blocks) for k in keys}
+        out["enabled"] = any(b.get("enabled") for b in blocks)
+        out["tier"] = "aggregate"
+        out["block_len"] = max((int(b.get("block_len") or 0)
+                                for b in blocks), default=0)
+        out["block_bytes"] = max((int(b.get("block_bytes") or 0)
+                                  for b in blocks), default=0)
+        with_ms = [b for b in blocks
+                   if b.get("transfer_p50_ms") is not None]
+        out["transfer_p50_ms"] = (with_ms[0]["transfer_p50_ms"]
+                                  if len(with_ms) == 1 else None)
+        out["transfer_p99_ms"] = (with_ms[0].get("transfer_p99_ms")
+                                  if len(with_ms) == 1 else None)
+        return out
+
+
+@dataclasses.dataclass
 class RouterStats:
     """Counters owned by runtime/router.Router — placement decisions,
     failover retries, and per-replica breaker events, surfaced as the

@@ -74,6 +74,10 @@ EVENT_KINDS = (
     "finish",         # terminal: natural finish (reason, n_out)
     "error",          # terminal: structured error frame (code, retryable)
     "route",          # router placement (replica, reason, attempt)
+    "kv_fill",        # cross-replica KV block fill (runtime/
+    #                   kv_transfer.py): donor, transport=wire|local,
+    #                   answered/filled tokens, ms, ok — linked under
+    #                   the request's trace id
     "failover",       # retryable pre-stream failure -> re-place (replica,
     #                   code)
     "circuit",        # breaker transition (scope=router|engine|spawn,
@@ -714,6 +718,65 @@ def _add_spec(p: _Prom, spec: dict | None, *, labels: dict | None = None,
           help_=f"Mean tokens emitted per verify forward{per}")
 
 
+_KVX_COUNTERS = (
+    ("fills_requested", "kv_transfer_fills_requested_total",
+     "Cache-fill attempts (a sibling's cache led the placed replica's)"),
+    ("fills_ok", "kv_transfer_fills_total",
+     "Fills that imported >= 1 block (the re-prefill actually avoided)"),
+    ("fill_fallbacks", "kv_transfer_fallbacks_total",
+     "Fills degraded to a plain local re-prefill (donor death, torn "
+     "frame, deadline — never a request failure)"),
+    ("fill_misses", "kv_transfer_fill_misses_total",
+     "Donor answered shorter than the shadow promised (eviction)"),
+    ("tokens_filled", "kv_transfer_tokens_filled_total",
+     "Prompt tokens imported instead of re-prefilled"),
+    ("blocks_filled", "kv_transfer_blocks_filled_total",
+     "Arena blocks imported"),
+    ("blocks_exported", "kv_transfer_blocks_exported_total",
+     "Arena blocks served to siblings (donor side)"),
+    ("queries_served", "kv_transfer_queries_total",
+     "RMSG_BLOCK_QUERY connections served (donor side)"),
+    ("prefill_passes", "kv_transfer_prefill_passes_total",
+     "Disaggregated prefill-tier passes completed"),
+    ("prefill_pass_fallbacks", "kv_transfer_prefill_fallbacks_total",
+     "Requests that fell back to the unified mixed path"),
+    ("shadow_truncates", "kv_transfer_shadow_truncates_total",
+     "Stale shadow-index paths cleared by a QUERY miss answer"),
+)
+
+
+def _add_kv_transfer(p: _Prom, kvx: dict | None, *,
+                     labels: dict | None = None,
+                     prefix: str = "dllama_") -> None:
+    """The KV block transfer family (runtime/kv_transfer.py,
+    stats.KVTransferStats summary): fills, fallbacks, bytes, and
+    transfer-time tails in every tier incl. idle — the block is attached
+    even with transfer off (enabled=False, zeros), so the family can
+    never vanish off a launch flag. One renderer for the top-level
+    aggregate and each replica's block (`dllama_replica_kv_transfer_*`,
+    replica-labelled)."""
+    if not kvx:
+        return
+    per = " (per replica)" if prefix != "dllama_" else ""
+    p.add(f"{prefix}kv_transfer_info", 1,
+          {**(labels or {}), "enabled": str(bool(kvx.get("enabled"))),
+           "tier": _esc(kvx.get("tier", "mixed"))},
+          help_=f"Transfer plane identity (constant 1){per}")
+    for key, name, help_ in _KVX_COUNTERS:
+        p.add(f"{prefix}{name}", kvx.get(key), labels, type_="counter",
+              help_=help_ + per)
+    for key, dirn in (("bytes_rx", "rx"), ("bytes_tx", "tx")):
+        p.add(f"{prefix}kv_transfer_bytes_total", kvx.get(key),
+              {**(labels or {}), "dir": dirn}, type_="counter",
+              help_=f"Block K/V payload bytes moved{per} (frame-exact "
+                    "wire bytes live in the per-replica wire ledger)")
+    p.add(f"{prefix}kv_transfer_ms", kvx.get("transfer_p50_ms"),
+          {**(labels or {}), "quantile": "0.5"},
+          help_=f"Whole-fill wall ms (connect to last import){per}")
+    p.add(f"{prefix}kv_transfer_ms", kvx.get("transfer_p99_ms"),
+          {**(labels or {}), "quantile": "0.99"})
+
+
 def _add_admission(p: _Prom, adm: dict | None, *,
                    labels: dict | None = None,
                    prefix: str = "dllama_") -> None:
@@ -805,6 +868,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                   help_="Batch knee that capped the auto-sizing")
         _add_admission(p, summary.get("admission"))
         _add_spec(p, summary.get("spec"))
+        _add_kv_transfer(p, summary.get("kv_transfer"))
         _add_device_blocks(p, summary)
         for rep in summary.get("replicas") or ():
             lab = {"replica": str(rep.get("replica"))}
@@ -834,6 +898,10 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
             # replica label, same rule as admission)
             _add_spec(p, rep.get("spec"), labels=lab,
                       prefix="dllama_replica_")
+            # per-replica transfer record (a worker's donor serving +
+            # its own fills — the aggregate block sums these)
+            _add_kv_transfer(p, rep.get("kv_transfer"), labels=lab,
+                             prefix="dllama_replica_")
             _add_device_blocks(p, rep, labels=lab)
             proc = rep.get("proc")
             if proc:

@@ -413,20 +413,31 @@ def wire_report(events: list[dict], bench_rows: list[dict]) -> dict | None:
             if rec.get("clock_offset_ms") is not None:
                 out["clock_offset_ms"] = rec["clock_offset_ms"]
 
+    kvx_blocks: list[dict] = []
     for row in bench_rows:
         w = row.get("wire")
-        if not isinstance(w, dict) or not w:
-            continue
-        if "peers" in w:  # a raw WireStats summary
-            eat_summary("", w)
-        else:             # {"root": summary, "worker": summary, ...}
-            for side, sub in w.items():
-                if isinstance(sub, dict) and "peers" in sub:
-                    eat_summary(side, sub)
-        if isinstance(w.get("reconcile"), dict):
-            # COPY: the drift flag is re-derived below, and the report
-            # must never mutate the caller's loaded bench rows
-            reconciles.append(dict(w["reconcile"]))
+        if isinstance(w, dict) and w:
+            if "peers" in w:  # a raw WireStats summary
+                eat_summary("", w)
+            else:             # {"root": summary, "worker": summary, ...}
+                for side, sub in w.items():
+                    if isinstance(sub, dict) and "peers" in sub:
+                        eat_summary(side, sub)
+            if isinstance(w.get("reconcile"), dict):
+                # COPY: the drift flag is re-derived below, and the
+                # report must never mutate the caller's loaded rows
+                reconciles.append(dict(w["reconcile"]))
+        # KV block transfer blocks (runtime/kv_transfer.py): a bench
+        # row's (or /stats dump's) kv_transfer summary, incl. its own
+        # measured-vs-modeled reconcile entry
+        kvx = row.get("kv_transfer")
+        if isinstance(kvx, dict) and kvx:
+            kvx_blocks.append(kvx)
+            if isinstance(kvx.get("reconcile"), dict):
+                reconciles.append(dict(kvx["reconcile"]))
+            sub = kvx.get("wire")
+            if isinstance(sub, dict) and "peers" in sub:
+                eat_summary("kvx", sub)
 
     syncs = [e for e in events if e.get("kind") == "sync"]
     sync = None
@@ -445,14 +456,37 @@ def wire_report(events: list[dict], bench_rows: list[dict]) -> dict | None:
                            if total_dev else None),
         }
 
-    if not peers and sync is None and not reconciles:
+    kvx = None
+    if kvx_blocks:
+        # sum the counters across blocks (a disaggregated bench row may
+        # carry one block per party); transfer tails only report when
+        # exactly one block has them (percentiles do not merge)
+        keys = ("fills_requested", "fills_ok", "fill_fallbacks",
+                "fill_misses", "tokens_filled", "blocks_filled",
+                "bytes_rx", "bytes_tx", "blocks_exported",
+                "queries_served", "query_misses", "prefill_passes",
+                "prefill_pass_fallbacks", "shadow_truncates")
+        kvx = {k: sum(int(b.get(k) or 0) for b in kvx_blocks)
+               for k in keys}
+        with_ms = [b for b in kvx_blocks
+                   if b.get("transfer_p50_ms") is not None]
+        kvx["transfer_p50_ms"] = (with_ms[0]["transfer_p50_ms"]
+                                  if len(with_ms) == 1 else None)
+        kvx["transfer_p99_ms"] = (with_ms[0].get("transfer_p99_ms")
+                                  if len(with_ms) == 1 else None)
+        req = kvx["fills_requested"]
+        kvx["fill_hit_rate"] = (_rnd(kvx["fills_ok"] / req, 4)
+                                if req else None)
+
+    if not peers and sync is None and not reconciles and kvx is None:
         return None
     # re-derive the drift flag locally: committed artifacts may predate
     # the producer's threshold, and the report must flag consistently
     for rec in reconciles:
         if rec.get("drift_frac") is not None:
             rec["drift"] = rec["drift_frac"] >= WIRE_DRIFT_FRAC
-    return {"peers": peers, "sync": sync, "reconcile": reconciles,
+    return {"peers": peers, "sync": sync, "kv_transfer": kvx,
+            "reconcile": reconciles,
             "drift": any(r.get("drift") for r in reconciles)}
 
 
@@ -636,6 +670,24 @@ def render_markdown(report: dict) -> str:
                       f"{sync['sync_p50_ms']} ms of device p50 "
                       f"{sync['device_p50_ms']} ms — **share "
                       f"{sync['sync_share']}**.", ""]
+        kvx = w.get("kv_transfer")
+        if kvx:
+            lines += ["### KV transfer", "",
+                      f"Fills: {kvx['fills_ok']}/"
+                      f"{kvx['fills_requested']} ok "
+                      f"(hit rate {kvx.get('fill_hit_rate')}), "
+                      f"{kvx['fill_fallbacks']} degraded to re-prefill, "
+                      f"{kvx['fill_misses']} donor misses.",
+                      f"Moved: {kvx['tokens_filled']} tokens / "
+                      f"{kvx['blocks_filled']} blocks "
+                      f"({kvx['bytes_rx']} B rx, {kvx['bytes_tx']} B "
+                      f"tx); transfer p50/p99 "
+                      f"{kvx.get('transfer_p50_ms')}/"
+                      f"{kvx.get('transfer_p99_ms')} ms.",
+                      f"Disaggregation: {kvx['prefill_passes']} prefill "
+                      f"passes, {kvx['prefill_pass_fallbacks']} mixed-"
+                      f"path fallbacks; {kvx['shadow_truncates']} stale "
+                      f"shadow paths cleared.", ""]
         for rec in w.get("reconcile") or ():
             flag = " ⚠️ **DRIFTED**" if rec.get("drift") else " (ok)"
             lines.append(
@@ -772,8 +824,43 @@ def _selftest() -> int:
     # the analyzer without --wire is unchanged (no section, no key)
     assert "wire" not in analyze(events, [wire_row]), "wire leaked"
 
+    # the KV transfer section (runtime/kv_transfer.py): a bench row's
+    # kv_transfer block -> fills/bytes/disagg lines + its reconcile
+    # entry folded into the wire report (exact reads clean; drift flags)
+    kvx_row = {"metric": "kvx-selftest", "kv_transfer": {
+        "enabled": True, "tier": "aggregate",
+        "fills_requested": 4, "fills_ok": 3, "fill_fallbacks": 1,
+        "fill_misses": 1, "tokens_filled": 96, "blocks_filled": 6,
+        "bytes_rx": 6144, "bytes_tx": 6144, "blocks_exported": 6,
+        "queries_served": 4, "query_misses": 1, "prefill_passes": 2,
+        "prefill_pass_fallbacks": 1, "shadow_truncates": 1,
+        "transfer_p50_ms": 2.5, "transfer_p99_ms": 4.0,
+        "reconcile": {"measured": 6144.0, "modeled": 6144.0,
+                      "unit": "bytes", "drift_frac": 0.0,
+                      "drift": False}}}
+    rk = analyze(events, [kvx_row], wire=True)["wire"]
+    assert rk is not None and rk["kv_transfer"] is not None, rk
+    assert rk["kv_transfer"]["fills_ok"] == 3, rk["kv_transfer"]
+    assert rk["kv_transfer"]["fill_hit_rate"] == 0.75
+    assert rk["kv_transfer"]["transfer_p50_ms"] == 2.5
+    assert not rk["drift"], rk
+    md_k = render_markdown({**rw, "wire": rk})
+    assert "KV transfer" in md_k and "3/4 ok" in md_k, md_k
+    kvx_drift = {"metric": "kvx2", "kv_transfer": {
+        "fills_requested": 1, "fills_ok": 1, "fill_fallbacks": 0,
+        "fill_misses": 0, "tokens_filled": 16, "blocks_filled": 1,
+        "bytes_rx": 1300, "bytes_tx": 1300, "blocks_exported": 1,
+        "queries_served": 1, "query_misses": 0, "prefill_passes": 0,
+        "prefill_pass_fallbacks": 0, "shadow_truncates": 0,
+        "reconcile": {"measured": 1300.0, "modeled": 1000.0,
+                      "unit": "bytes", "drift_frac": 0.3,
+                      "drift": True}}}
+    rkd = analyze(events, [kvx_drift], wire=True)["wire"]
+    assert rkd["drift"], rkd
+
     print("dlprof selftest: OK (knee=4, 3 spans, autotune drift check, "
-          "wire section + sync share + drift flag, report renders)")
+          "wire section + sync share + drift flag, KV transfer section, "
+          "report renders)")
     return 0
 
 
