@@ -58,7 +58,12 @@ block transfer row (_kvx_row: cold-replica fills OFF vs ON on a
 shared-prefix trace — TTFT p50, fill hit rate, wire bytes reconciled —
 plus the disaggregated prefill/decode A/B;
 BENCH_KVX_FAMILIES/_SYS/_BLOCK/_TOKENS/_IAT/_LONG/_STREAMS size it), and
-BENCH_SPEC=1 to add the REAL-draft
+BENCH_VOCAB=1 to add the
+vocab-sharding A/B row (_vocab_row: sharded vs replicated embedding+head
+on one mixed greedy/sampled trace over a tp mesh — greedy parity
+asserted, per-chip embedding+wcls bytes and head+sample ms per variant,
+zero frozen-ledger compiles; BENCH_VOCAB_TP/_BATCH/_REQUESTS/_TOKENS/
+_STEPS size it), BENCH_SPEC=1 to add the REAL-draft
 speculative-decoding row (_spec_row: truncated-depth self-draft vs
 prompt-lookup vs plain greedy on a fixed-seed NON-repetitive eval with
 the measured accept rate ON the row, plus a Poisson serving A/B with
@@ -2332,7 +2337,143 @@ def _kvx_row(params, spec: ModelSpec, prefix: str) -> dict:
     }
 
 
+def _vocab_child() -> None:
+    """Child body of the BENCH_VOCAB row (own process: the vocab A/B
+    needs a tp mesh, and the virtual-device XLA flag is parse-once per
+    process). Serves the SAME mixed greedy/sampled trace through a real
+    Scheduler on a tp mesh twice — vocab-sharded vs replicated head —
+    asserting greedy token parity, then times the head+sample path of
+    one decode step per variant and reads both HBM ledgers. Prints ONE
+    JSON line on stdout."""
+    import gc
+    import time
+
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.runtime.profiler import COMPILES, hbm_ledger
+    from distributed_llama_tpu.runtime.scheduler import Scheduler
+    from distributed_llama_tpu.sampler import Sampler
+
+    tp = int(os.environ.get("BENCH_VOCAB_TP", "2"))
+    b = int(os.environ.get("BENCH_VOCAB_BATCH", "2"))
+    n_req = max(int(os.environ.get("BENCH_VOCAB_REQUESTS", "8")), 2)
+    budget = int(os.environ.get("BENCH_VOCAB_TOKENS", "8"))
+    steps = int(os.environ.get("BENCH_VOCAB_STEPS", "30"))
+    spec = TINY
+    params = synth_q40_params(spec)
+
+    def serve(shard: bool):
+        mesh = make_mesh(tp=tp, dp=1)
+        eng = Engine(spec, dict(params), mesh, batch=b,
+                     compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16,
+                     max_seq_len=spec.seq_len, shard_vocab=shard)
+        sched = Scheduler(eng, chunk=32)
+        sched.warmup()
+        COMPILES.reset()
+        eng.mark_compile_warm()  # frozen-ledger bar: serving the trace
+        COMPILES.freeze = True   # must mint ZERO new keys per variant
+        outs = []
+        try:
+            reqs = []
+            for i in range(n_req):
+                # even requests greedy (parity bar), odd sampled at a
+                # fixed seed (the sharded candidate path must serve them)
+                temp = 0.0 if i % 2 == 0 else 0.8
+                smp = Sampler(spec.vocab_size, temp, 0.9, seed=1234 + i,
+                              backend="python")
+                reqs.append(sched.submit(
+                    [1 + i % 7, 5, 9 + i % 3, 2], budget, smp))
+            while sched.has_work():
+                sched.step()
+            outs = [list(r.tokens()) for r in reqs]
+            # parity bar = GREEDY rows only (even indices): sampled rows
+            # are distribution-exact but their candidate probabilities
+            # are the DEVICE softmax — a 1-ulp difference vs the host
+            # softmax near a crossing could legitimately flip a sampled
+            # token, and the design never promises sampled bit-parity
+            greedy_outs = outs[0::2]
+            frozen_delta = COMPILES.after_warmup
+            # head+sample wall: one gated decode dispatch + the host
+            # sample path (full (B, V) fetch vs sharded summaries)
+            gate = np.full((b,), eng.seq_len, np.int32)
+            tokz = np.zeros((b, 1), np.int32)
+            view_vocab = spec.vocab_size
+            smp_t = Sampler(spec.vocab_size, 0.0, 0.9, seed=7,
+                            backend="python")
+            best = None
+            for _ in range(max(steps, 3)):
+                t0 = time.perf_counter()
+                lg = eng.slot_decode_step(tokz, gate)
+                view = eng.sample_view(lg, None, view_vocab)
+                view.sample(smp_t, 0)
+                dt = (time.perf_counter() - t0) * 1e3
+                best = dt if best is None else min(best, dt)
+            led = hbm_ledger(eng, device_stats=False)
+        finally:
+            COMPILES.freeze = False
+            sched.close()
+        stats = dict(getattr(eng, "vocab_sample_stats", {}))
+        del eng, sched
+        gc.collect()
+        return greedy_outs, outs, best, led, frozen_delta, stats
+
+    g_on, outs_on, head_on, led_on, froz_on, st_on = serve(True)
+    g_off, outs_off, head_off, led_off, froz_off, _ = serve(False)
+    print(json.dumps({
+        "tp": tp, "batch": b, "requests": n_req,
+        "token_parity": g_on == g_off,
+        "sampled_parity": outs_on == outs_off,  # informational: holds
+        # unless device/host softmax rounding flips a draw
+        "head_sample_ms_sharded": round(head_on, 3),
+        "head_sample_ms_replicated": round(head_off, 3),
+        "vocab_bytes_per_chip_sharded": led_on["vocab_bytes"],
+        "vocab_bytes_per_chip_replicated": led_off["vocab_bytes"],
+        "logits_ws_bytes_sharded": led_on["logits_workspace_bytes"],
+        "logits_ws_bytes_replicated": led_off["logits_workspace_bytes"],
+        "compiles_after_warmup_sharded": froz_on,
+        "compiles_after_warmup_replicated": froz_off,
+        "sampled_via_candidates": st_on.get("sharded", 0),
+        "sampled_fallbacks": st_on.get("fallback", 0),
+    }))
+
+
+def _vocab_row(prefix: str) -> dict:
+    """BENCH_VOCAB=1: the vocab-sharding A/B (ISSUE-15) — sharded vs
+    replicated embedding+head on the same mixed greedy/sampled trace,
+    greedy tokens asserted IDENTICAL, per-chip embedding+wcls bytes and
+    the head+sample ms on the row, zero frozen-ledger compiles per
+    variant. Runs in a child process: the tp mesh needs virtual CPU
+    devices, and XLA parses that flag once per process."""
+    env = dict(os.environ)
+    env["BENCH_VOCAB_CHILD"] = "1"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       capture_output=True, text=True, timeout=900,
+                       env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    if r.returncode != 0:
+        return {"metric": f"{prefix}_vocab_shard_head_sample_ms",
+                "value": None, "unit": "ms",
+                "error": (r.stderr or r.stdout)[-400:]}
+    child = json.loads(r.stdout.strip().splitlines()[-1])
+    assert child["token_parity"], "vocab-sharded greedy tokens diverged"
+    row = {
+        "metric": f"{prefix}_vocab_shard_head_sample_ms",
+        "value": child["head_sample_ms_sharded"], "unit": "ms",
+        "vs_baseline": None,
+        "vs_replicated": (round(child["head_sample_ms_sharded"]
+                                / child["head_sample_ms_replicated"], 3)
+                          if child["head_sample_ms_replicated"] else None),
+    }
+    row.update(child)
+    return row
+
+
 def main() -> None:
+    if os.environ.get("BENCH_VOCAB_CHILD"):
+        _vocab_child()
+        return
     model = os.environ.get("BENCH_MODEL", "7b")
     # 512-token decode: the ~140 ms tunnel dispatch cost amortizes to
     # <0.3 ms/token and attention runs at realistic steady-state fill
@@ -2484,6 +2625,13 @@ def main() -> None:
             # disaggregated prefill/decode A/B against a unified tier
             emit(_with_step_timeline(_kvx_row, params, spec,
                                      prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_VOCAB", "0") != "0":
+            # vocab-sharding A/B row (ops/sharded_vocab.py, ISSUE-15):
+            # sharded vs replicated embedding+head on the same trace,
+            # greedy parity asserted, per-chip vocab bytes + head ms
+            # (child process: the tp mesh needs virtual devices)
+            emit(_vocab_row(prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_SPEC", "0") != "0":
             # real-draft speculative decoding row (runtime/draft.py):

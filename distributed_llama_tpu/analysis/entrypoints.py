@@ -249,6 +249,10 @@ def entry_points(max_devices: int | None = None,
         "slot_verify", slot_verify, (params_v, tok_v, pos_v, cache_v),
         {"activation_elems": 4 * 3 * spec_v.dim, "dim": spec_v.dim}))
 
+    if n_dev < 2:
+        unavailable += [("embed_tokens_sharded", 2),
+                        ("sharded_sample_prep", 2)]
+
     if n_dev >= 2:
         from ..parallel import make_mesh
         from ..parallel.tp_q80 import tp_col_matmul, tp_row_matmul
@@ -256,6 +260,45 @@ def entry_points(max_devices: int | None = None,
         mesh = make_mesh(tp=2, dp=1)
         dim, hidden = 64, 128
         x = jnp.zeros((1, 1, hidden), jnp.float32)
+
+        # -- vocab sharding (ops/sharded_vocab.py) ------------------------
+        # embed_tokens_sharded: the masked local gather + all-reduce that
+        # replaces the replicated emb[tokens] lookup. Traced through the
+        # SAME module-level body the engine's forward() calls, so the
+        # pinned fingerprint covers the real serving embedding path.
+        from ..ops.sharded_vocab import (embed_tokens_sharded,
+                                         sharded_sample_prep)
+
+        spec_e = _tiny_spec()
+        emb_e = jnp.zeros((spec_e.vocab_size, spec_e.dim), jnp.float32)
+        tok_e = jnp.zeros((2, 4), jnp.int32)
+
+        def embed_tokens(emb, tok):
+            return embed_tokens_sharded(emb, tok, mesh, ("tp",),
+                                        jnp.float32)
+
+        out.append(EntryPoint(
+            "embed_tokens_sharded", embed_tokens, (emb_e, tok_e),
+            {"activation_elems": 2 * 4 * spec_e.dim, "dim": spec_e.dim},
+            needs_mesh=2))
+
+        # sharded_sample_prep: the serving-path sampling summary — device
+        # argmax + per-shard top-k candidates off vocab-sharded logits.
+        # meta["vocab"] arms DLG205: no output (and no all_gather) of
+        # this program may carry a vocab-sized dim — the whole point is
+        # that full logits never materialize on the serving path.
+        lg_s2 = jnp.zeros((4, spec_e.vocab_size), jnp.float32)
+        temps_s = jnp.ones((4,), jnp.float32)
+
+        def sample_prep(logits, temps):
+            return sharded_sample_prep(logits, temps, mesh, ("tp",),
+                                       spec_e.vocab_size, 8)
+
+        out.append(EntryPoint(
+            "sharded_sample_prep", sample_prep, (lg_s2, temps_s),
+            {"activation_elems": 4 * spec_e.dim, "dim": spec_e.dim,
+             "vocab": spec_e.vocab_size},
+            needs_mesh=2))
 
         # -- q80-compressed col-split reduce (the wire-compression path) --
         from ..parallel.tp_q80 import repack_col_tp

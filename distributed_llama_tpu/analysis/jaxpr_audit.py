@@ -25,8 +25,13 @@ walked for:
           baseline — the jit compilation key changed (an input dtype
           widened, a scalar became weak-typed, an argument appeared):
           every distinct call now recompiles or the cache key churns
+  DLG205  full-vocab logits materialization in a vocab-sharded serving
+          program (entries declaring meta["vocab"]): a program output or
+          an all_gather with a vocab-sized dim — the sharded sampling
+          path (ops/sharded_vocab.py) exists so only candidate
+          summaries ever cross to the host
 
-Severity: DLG201/202/203 are errors, DLG204 a warning (legitimate
+Severity: DLG201/202/203/205 are errors, DLG204 a warning (legitimate
 signature changes are accepted by re-running with --update-baseline).
 DLG200 (error) reports an entry point the backend could not audit at all
 (too few devices) — the gate must fail loudly rather than pass vacuously.
@@ -141,6 +146,38 @@ def audit_entry(ep: EntryPoint) -> tuple[list[Finding], str]:
                 f"{np.dtype(dt).name}) — the sharded-on-entry tensor "
                 "comes back replicated; use a psum/reduce_scatter or the "
                 "q80 exchange (parallel/collectives.py)"))
+
+    # DLG205: full-vocab logits materialization on a vocab-sharded
+    # serving program (entries declaring meta["vocab"]). Two shapes of
+    # the leak: the program RETURNS an array with a vocab-sized dim
+    # (the host fetch would gather the whole head output), or an
+    # all_gather inside it re-replicates one (the sharded matmul's
+    # output coming back whole). The sharded sampling path exists
+    # precisely so only (B, S·k) candidate summaries cross; a
+    # vocab-sized anything here is the regression this rule guards.
+    vocab = int(ep.meta.get("vocab", 0))
+    if vocab:
+        for var in closed.jaxpr.outvars:
+            aval = getattr(var, "aval", None)
+            dims = tuple(getattr(aval, "shape", ()) or ())
+            if any(d == vocab for d in dims if isinstance(d, int)):
+                findings.append(Finding(
+                    "DLG205", "error", file, 0,
+                    f"program output of shape {dims} carries a full "
+                    f"vocab ({vocab}) dim — the serving path must fetch "
+                    "candidate summaries, never the logits "
+                    "(ops/sharded_vocab.py)"))
+        for eqn in _iter_eqns(closed.jaxpr):
+            if eqn.primitive.name not in GATHER_PRIMITIVES:
+                continue
+            out = eqn.outvars[0]
+            aval = getattr(out, "aval", None)
+            dims = tuple(getattr(aval, "shape", ()) or ())
+            if any(d == vocab for d in dims if isinstance(d, int)):
+                findings.append(Finding(
+                    "DLG205", "error", file, 0,
+                    f"all_gather re-replicates a full-vocab array "
+                    f"{dims} inside a vocab-sharded serving program"))
 
     # DLG202: f64 promotion, visible only under x64 tracing
     closed64 = make_jaxpr_for(ep, x64=True)

@@ -239,8 +239,10 @@ class ApiState:
         ``exclusive``/``ready``/``summary``), so 1 and N replicas serve
         through identical code. Every replica's engine SHARES this
         engine's param device buffers — replication costs KV caches and
-        prefix arenas, never weight copies. Single-device only —
-        serve() refuses --serve-batch on meshes/clusters at startup."""
+        prefix arenas, never weight copies. Single-process only; a tp
+        mesh composes on the single-supervisor tier (the vocab-sharded
+        serving path) — serve() refuses every other mesh axis, cluster,
+        and tp×replicas combination at startup."""
         with self.engine_lock:  # two first requests must not double-build
             if self._scheduler is None:
                 from ..runtime.router import build_front_door
@@ -1559,15 +1561,27 @@ def serve(args) -> None:
     check_session_flags(args)
     serve_batch = getattr(args, "serve_batch", 0)
     if serve_batch:
-        # the scheduler's batch engine is single-process/single-device by
-        # design: a mesh needs sharded-batch plumbing and a cluster needs
-        # request replay for b-row steps — loud error beats a silently
-        # ignored flag
+        # the scheduler's batch engine is single-process by design (a
+        # cluster needs request replay for b-row steps) and composes
+        # with exactly ONE mesh axis: tp — the slot programs gate rows
+        # by position, which is dp/sp/pp-agnostic only on paper, and tp
+        # is what vocab sharding (ops/sharded_vocab.py) serves through.
+        # Loud error beats a silently ignored flag for the rest.
         if getattr(args, "nnodes", 1) > 1 or jax.process_count() > 1:
             sys.exit("error: --serve-batch does not compose with --nnodes")
-        if max(getattr(args, k, 1) for k in ("tp", "dp", "sp", "ep", "pp")) > 1:
-            sys.exit("error: --serve-batch needs a single-device engine "
-                     "(no --tp/--dp/--sp/--ep/--pp)")
+        if max(getattr(args, k, 1) for k in ("dp", "sp", "ep", "pp")) > 1:
+            sys.exit("error: --serve-batch needs a single-process engine "
+                     "(no --dp/--sp/--ep/--pp; --tp composes)")
+        if getattr(args, "tp", 1) > 1 and (
+                getattr(args, "replicas", 1) > 1
+                or getattr(args, "replica_procs", 0)
+                or getattr(args, "replica_hosts", None)):
+            # one tp mesh = one engine's devices: replicas would contend
+            # for the same chips (ROADMAP item 3's remaining work is
+            # exactly workers spanning their own meshes)
+            sys.exit("error: --serve-batch with --tp serves the "
+                     "single-supervisor tier only (no --replicas/"
+                     "--replica-procs/--replica-hosts)")
         if session:
             # scheduler slots are leased per request — there is no single
             # prefix cache a --session file could describe

@@ -95,6 +95,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "pp caches are not host-fetchable) and "
                         "--buffer-float-type q80 is ignored in favor of "
                         "exact f32 collectives")
+    p.add_argument("--shard-vocab", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="row-split the embedding table and logits head "
+                        "over the vocab dim (ops/sharded_vocab.py): the "
+                        "replicated 533 MB/chip table at 70B widths "
+                        "becomes vocab/tp per chip, and serving never "
+                        "materializes full logits (sharded argmax + "
+                        "candidate top-k/top-p, greedy bit-identical, "
+                        "sampled distribution-exact). auto = on whenever "
+                        "the mesh's tp axes divide the vocab; off keeps "
+                        "the replicated parity oracle")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--compute-dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--cache-dtype", default="bf16",
@@ -187,9 +198,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "from HBM-ledger headroom capped by the batch knee "
                         "(--autotune artifact, or a conservative default) "
                         "— the decision is logged and exported on /stats "
-                        "(docs/serving.md 'Auto-sizing'). Single-process, "
-                        "single-device engines only. Net-new: the "
-                        "reference serves batch=1")
+                        "(docs/serving.md 'Auto-sizing'). Single-process "
+                        "engines only; --tp composes (the vocab-sharded "
+                        "serving path), other mesh axes and --nnodes are "
+                        "refused. Net-new: the reference serves batch=1")
     p.add_argument("--serve-chunk", type=int, default=0, metavar="C",
                    help="api mode: prefill chunk width for the continuous-"
                         "batching scheduler (tail chunks pad to C, so C is "
@@ -587,9 +599,16 @@ def build_engine(args):
         # identical tensor stream with no local .m
         from ..parallel.multihost import bcast_model_tensors
         tensor_src = bcast_model_tensors(spec, args.model or None)
+    # ONE resolution of the --shard-vocab tri-state, shared by the loader
+    # and the engine: they MUST agree — the loader places tok_emb/wcls in
+    # the layout the engine keeps, so a drift here would silently
+    # reintroduce the load-time reshard (a transient replicated 524
+    # MB/chip table at 70B widths)
+    shard_vocab = {"auto": None, "on": True, "off": False}[
+        getattr(args, "shard_vocab", "auto")]
     params, lstats = load_params_streamed(
         spec, args.model, mesh, mode=mode, dtype=cdt, q80_collectives=q80,
-        tensors=tensor_src)
+        tensors=tensor_src, shard_vocab=shard_vocab)
     print(f"⏩ loaded {lstats.total_bytes / 1e9:.2f} GB in "
           f"{time.perf_counter()-t0:.1f}s (peak host "
           f"{lstats.peak_host_bytes / 1e6:.0f} MB)")
@@ -604,6 +623,10 @@ def build_engine(args):
         # folded into the KV-session fingerprint: a session saved from a
         # same-shape different-weight model must be refused (ADVICE r3)
         model_fingerprint=model_fp,
+        # vocab sharding: None (auto) enables whenever the mesh's tp
+        # axes divide the vocab; resolved ONCE above, shared with the
+        # loader placement
+        shard_vocab=shard_vocab,
     )
 
     tokenizer = Tokenizer.from_file(args.tokenizer)
@@ -1271,6 +1294,14 @@ def main(argv: list[str] | None = None) -> None:
         if args.device_sampling:
             sys.exit("error: --draft is host-loop decoding; it does "
                      "not compose with --device-sampling")
+    if (getattr(args, "shard_vocab", "auto") == "on" and args.tp <= 1
+            and args.nnodes <= 1):
+        # dead-flag discipline: an explicit "on" needs a tp mesh to split
+        # over (auto simply stays off); multihost defaults tp to the
+        # cluster width, so only the unambiguous single-node case refuses
+        sys.exit("error: --shard-vocab on needs a tensor-parallel mesh "
+                 "(--tp > 1) to split the vocab over; 'auto' enables it "
+                 "whenever the mesh allows")
     if args.session and args.pp > 1:
         sys.exit("error: --session does not compose with --pp > 1 — "
                  "save_session fetches the KV cache to the host, and "

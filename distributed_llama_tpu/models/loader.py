@@ -112,13 +112,18 @@ class _Placer:
     E/(ep*tp), never full-E (the point of placement-EP)."""
 
     def __init__(self, mesh, mode: str, dtype, tp: int, q80_collectives: bool,
-                 ep: int = 1):
+                 ep: int = 1, vocab_axes: tuple | None = None):
         self.mesh = mesh
         self.mode = mode
         self.dtype = dtype
         self.tp = tp
         self.q80 = q80_collectives and tp > 1
         self.ep = ep
+        # vocab sharding (ops/sharded_vocab.py): tok_emb/wcls place
+        # row-split over these axes AT LOAD — the 70B-scale path must
+        # never hold a replicated 524 MB table per device only for the
+        # engine to reshard it
+        self.vocab_axes = vocab_axes
 
     def _put(self, x: np.ndarray, pspec):
         if self.mesh is None:
@@ -126,7 +131,8 @@ class _Placer:
         return jax.device_put(x, NamedSharding(self.mesh, pspec))
 
     def dense(self, key: str, x: np.ndarray):
-        return self._put(x, _pspec_for(key, x.ndim, False, "dense"))
+        return self._put(x, _pspec_for(key, x.ndim, False, "dense",
+                                       self.vocab_axes))
 
     def weight(self, key: str, ts: list[HostTensor]):
         """A matmul weight: single tensor, or an E-stacked expert list.
@@ -159,7 +165,8 @@ class _Placer:
                 return EpRowWeight(
                     arr.astype(self.dtype) if self.dtype == jnp.bfloat16
                     else arr)
-            arr = self._put(x, _pspec_for(key, x.ndim, False, "dense"))
+            arr = self._put(x, _pspec_for(key, x.ndim, False, "dense",
+                                          self.vocab_axes))
             return arr.astype(self.dtype) if self.dtype == jnp.bfloat16 else arr
 
         packed, scales = _q40_raw_stack(ts)
@@ -174,8 +181,10 @@ class _Placer:
                 self._put(sc, ep_row_pspec(sc.ndim)),
             ))
         return QuantizedTensor(
-            self._put(pk, _pspec_for(key, pk.ndim, True, "packed")),
-            self._put(sc, _pspec_for(key, sc.ndim, True, "scales")),
+            self._put(pk, _pspec_for(key, pk.ndim, True, "packed",
+                                     self.vocab_axes)),
+            self._put(sc, _pspec_for(key, sc.ndim, True, "scales",
+                                     self.vocab_axes)),
         )
 
     def _col_q40(self, packed: np.ndarray, scales: np.ndarray,
@@ -369,6 +378,7 @@ def load_params_streamed(
     q80_collectives: bool = False,
     fuse: bool | None = None,
     tensors=None,
+    shard_vocab: bool | None = None,
 ) -> tuple[dict, LoadStats]:
     """Stream the `.m` file into a final, placed params pytree.
 
@@ -400,7 +410,22 @@ def load_params_streamed(
         assert not q80_collectives, (
             "pp loading uses exact reduces (matching Engine)")
     n_slot = spec.n_layers // pp
-    placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep)
+    # vocab sharding (ops/sharded_vocab.py): place tok_emb/wcls row-split
+    # at load — same auto rule as the Engine, so the arrays arrive in the
+    # layout shard_params expects and nothing reshards (a replicated 70B
+    # table would otherwise cost 524 MB on EVERY device just to be thrown
+    # away). shard_vocab=False pins the replicated parity placement.
+    from ..ops.sharded_vocab import vocab_shard_axes
+
+    vocab_axes: tuple | None = None
+    if shard_vocab is not False:
+        vocab_axes = vocab_shard_axes(mesh, spec.vocab_size) or None
+        if shard_vocab and vocab_axes is None:
+            raise ValueError(
+                f"shard_vocab: mesh tp axes cannot split vocab="
+                f"{spec.vocab_size} evenly")
+    placer = _Placer(mesh, mode, dtype, tp, q80_collectives, ep=ep,
+                     vocab_axes=vocab_axes)
     pp_stack = _PpStacker(mesh, pp, tp=tp, ep=ep) if pp > 1 else None
 
     p: dict = {"layers": [dict() for _ in range(n_slot if pp > 1

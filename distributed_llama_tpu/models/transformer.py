@@ -443,6 +443,8 @@ def forward(
     pp_mesh=None,
     pp_gpipe: bool = True,
     logit_index=None,
+    vocab_mesh=None,
+    vocab_axes: tuple = ("tp",),
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model; returns (logits, updated cache).
 
@@ -460,13 +462,24 @@ def forward(
     pp_mesh: a Mesh whose pp axis places the layers in stages — params
     "layers" must be stage-stacked (parallel/pp.py:stack_stages) and the
     cache stage-stacked (KVCache.create(pp=...)).
+    vocab_mesh: a Mesh whose `vocab_axes` row-split the embedding table's
+    vocab dim (ops/sharded_vocab.py) — the lookup becomes a masked local
+    gather + all-reduce, bit-identical to the replicated gather (zeros +
+    one real contribution add exactly). The head (wcls) is row-split by
+    its PartitionSpec independently of this knob.
     """
     cfg = dict(activation_q80=activation_q80, compute_dtype=compute_dtype,
                use_pallas=use_pallas, tp_mesh=tp_mesh, tp_reduce=tp_reduce,
                pallas_interpret=pallas_interpret)
     b, t = tokens.shape
 
-    x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203
+    if vocab_mesh is not None:
+        from ..ops.sharded_vocab import embed_tokens_sharded
+
+        x = embed_tokens_sharded(params["tok_emb"], tokens, vocab_mesh,
+                                 tuple(vocab_axes), compute_dtype)
+    else:
+        x = params["tok_emb"][tokens].astype(compute_dtype)  # ref: tasks.cpp:202-203
     if spec.arch == ArchType.GROK1:
         x = x * GROK_INPUT_SCALE
 

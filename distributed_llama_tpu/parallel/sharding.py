@@ -59,7 +59,8 @@ _SPLIT = {
 }
 
 
-def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
+def _pspec_for(name: str, ndim: int, quantized: bool, which: str,
+               vocab_axes: tuple | None = None) -> P:
     """PartitionSpec for one array leaf.
 
     Dense weights are (lead..., d, n). Q40 leaves are packed (lead..., d, m)
@@ -72,13 +73,22 @@ def _pspec_for(name: str, ndim: int, quantized: bool, which: str) -> P:
     """
     split = _SPLIT[name]
     axes: list = [None] * ndim
+    if name in ("tok_emb", "wcls") and vocab_axes is not None:
+        # vocab sharding (ops/sharded_vocab.py): the embedding table
+        # row-splits its vocab dim — under pp over BOTH (pp, tp), since
+        # the gather/head run outside the manual region and every stage
+        # would otherwise hold a full copy. wcls keeps its row split but
+        # widens to the same axes.
+        if name == "tok_emb" or split == "row":
+            axes[ndim - 2] = vocab_axes
+            return P(*axes)
     if split is None:
         return P(*axes)
     axes[ndim - 2 if split == "row" else ndim - 1] = TP_AXIS
     return P(*axes)
 
 
-def _leaf_spec(name: str, w):
+def _leaf_spec(name: str, w, vocab_axes: tuple | None = None):
     from .ep_moe import EpColWeight, EpRowWeight, ep_pspec
     from .mesh import PP_AXIS
     from .pp import PpWeight
@@ -104,21 +114,24 @@ def _leaf_spec(name: str, w):
         return tp_row_pspec(w)
     if isinstance(w, QuantizedTensor):
         return QuantizedTensor(  # pytree-shaped specs
-            _pspec_for(name, w.packed.ndim, True, "packed"),
-            _pspec_for(name, w.scales.ndim, True, "scales"),
+            _pspec_for(name, w.packed.ndim, True, "packed", vocab_axes),
+            _pspec_for(name, w.scales.ndim, True, "scales", vocab_axes),
         )
-    return _pspec_for(name, w.ndim, False, "dense")
+    return _pspec_for(name, w.ndim, False, "dense", vocab_axes)
 
 
-def param_pspecs(params: dict) -> dict:
+def param_pspecs(params: dict, vocab_axes: tuple | None = None) -> dict:
     """Pytree of PartitionSpecs matching the params pytree
-    ({"tok_emb", "rms_final", "wcls", "layers": [{...}, ...]})."""
+    ({"tok_emb", "rms_final", "wcls", "layers": [{...}, ...]}).
+    vocab_axes: mesh axes row-splitting the vocab dim of tok_emb/wcls
+    (ops/sharded_vocab.vocab_shard_axes; None keeps them replicated/
+    tp-split as before)."""
     out = {}
     for name, w in params.items():
         if name == "layers":
             out[name] = [{k: _leaf_spec(k, v) for k, v in lw.items()} for lw in w]
         else:
-            out[name] = _leaf_spec(name, w)
+            out[name] = _leaf_spec(name, w, vocab_axes)
     return out
 
 
@@ -213,11 +226,11 @@ def wrap_row_weights(params: dict) -> dict:
     return out
 
 
-def shard_params(params: dict, mesh) -> dict:
+def shard_params(params: dict, mesh, vocab_axes: tuple | None = None) -> dict:
     """device_put every leaf with its NamedSharding (sharded weight placement —
     the analogue of the reference's per-worker weight push at load,
     ref: src/transformer.cpp:562-591)."""
-    specs = param_pspecs(params)
+    specs = param_pspecs(params, vocab_axes)
 
     def put(w, s):
         return jax.device_put(w, NamedSharding(mesh, s))

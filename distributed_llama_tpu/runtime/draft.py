@@ -215,7 +215,12 @@ class DraftModel:
         # unsharded keep the dtype/kernel knobs but no mesh.
         kw = self.engine._forward_kwargs()
         if self.label == "model":
-            kw.update(tp_mesh=None, sp_cache_mesh=None, pp_mesh=None)
+            # vocab_mesh too: a file-loaded draft's tok_emb/wcls are
+            # replicated single-device arrays — inheriting the target's
+            # vocab sharding would reshard the whole draft embedding
+            # through the sharded-gather shard_map on every dispatch
+            kw.update(tp_mesh=None, sp_cache_mesh=None, pp_mesh=None,
+                      vocab_mesh=None)
         return kw
 
     def new_cache(self) -> KVCache:

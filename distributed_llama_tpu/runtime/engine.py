@@ -141,6 +141,15 @@ class Engine:
         # run INSIDE manual regions on whatever silicon is present — the
         # single-chip proof of the multi-chip kernel path (VERDICT r4 #1;
         # bench.py's shardmap variant row)
+        shard_vocab: bool | None = None,  # row-split tok_emb/wcls over the
+        # vocab dim (ops/sharded_vocab.py): None = auto (on whenever the
+        # mesh's tp axes divide the vocab — the replicated table was
+        # 533 MB/chip at 70B widths, VERDICT weak #3); True asserts the
+        # mesh can; False pins the replicated parity oracle
+        vocab_topk: int = 32,  # per-shard candidate count for the sharded
+        # sampled path (k·S candidates provably contain the global top-k;
+        # a nucleus larger than the guard allows falls back to one
+        # replicated row fetch — docs/parallelism.md "Vocab sharding")
     ):
         self.mesh = mesh
         self.batch = batch
@@ -235,6 +244,31 @@ class Engine:
                 "pp uses exact tp reduces; --buffer-float-type q80 "
                 "is not supported with --pp")
 
+        # vocab sharding (ops/sharded_vocab.py): tok_emb becomes a local
+        # (vocab/S, dim) shard with a masked gather + all-reduce; wcls
+        # keeps its row split (widened over pp when present). Auto-on for
+        # tp > 1 whenever the vocab divides; the replicated path stays as
+        # the parity oracle (--shard-vocab off / shard_vocab=False).
+        from ..ops.sharded_vocab import vocab_shard_axes
+
+        axes = (vocab_shard_axes(mesh, spec.vocab_size)
+                if mesh is not None else ())
+        if shard_vocab is None:
+            self._vocab_axes = axes
+        elif shard_vocab:
+            assert axes, (
+                f"shard_vocab: mesh tp axes cannot split vocab="
+                f"{spec.vocab_size} evenly (tp="
+                f"{mesh.shape.get('tp', 1) if mesh is not None else 1})")
+            self._vocab_axes = axes
+        else:
+            self._vocab_axes = ()
+        self.shard_vocab = bool(self._vocab_axes)
+        self.vocab_topk = int(vocab_topk)
+        # counters the /stats + bench rows surface: how often the sharded
+        # fast path served a sample vs the replicated-row parity fallback
+        self.vocab_sample_stats = {"sharded": 0, "fallback": 0}
+
         if tp == 1:
             # single-shard fast path: fused QKV / w1|w3 kernel calls
             params = fuse_layer_weights(params)
@@ -285,7 +319,8 @@ class Engine:
                 from ..parallel.pp import stack_stages
 
                 params = stack_stages(params, pp)
-            self.params = shard_params(params, mesh)
+            self.params = shard_params(params, mesh,
+                                       self._vocab_axes or None)
             self._cache_sharding = NamedSharding(
                 mesh, cache_pspec(sp=sp > 1, pp=pp > 1))
             self._token_sharding = NamedSharding(mesh, P(DP_AXIS, None))
@@ -520,7 +555,9 @@ class Engine:
             self.spec, self.mesh,
             q80=self.q80_collectives,
             act_bytes=jnp.dtype(self.compute_dtype).itemsize,
-            batch=self.batch)
+            batch=self.batch,
+            shard_vocab=self.shard_vocab,
+            vocab_topk=self.vocab_topk)
 
     def measure_transfer_ms(self) -> float:
         """Measured per-token DECODE transfer estimate: times activation-
@@ -625,6 +662,8 @@ class Engine:
             sp_cache_mesh=self._sp_cache_mesh,
             pp_mesh=self._pp_mesh,
             pp_gpipe=self.pp_gpipe,
+            vocab_mesh=self.mesh if self.shard_vocab else None,
+            vocab_axes=self._vocab_axes or ("tp",),
         )
 
     def _compiled_step(self, key, *, sp_mesh=None,
@@ -702,6 +741,91 @@ class Engine:
                     out_shardings=NamedSharding(self.mesh, P())))
             logits = self._replicator(logits)
         return np.asarray(logits)
+
+    # -- sharded sampling (ops/sharded_vocab.py) ---------------------------
+
+    @property
+    def shard_sampling(self) -> bool:
+        """Whether sample_view serves the sharded fast path: vocab is
+        sharded and the host can fetch the tiny summaries directly
+        (multi-process meshes keep the replicated fetch_logits oracle —
+        their serving tiers are single-host anyway)."""
+        return self.shard_vocab and not self._multihost
+
+    def sample_view(self, logits, temps: np.ndarray | None, n_vocab: int):
+        """Sampling access to one step's (B, vocab) logits. Replicated
+        engines return a FullLogitsView (the fetch_logits + host-Sampler
+        oracle, exactly the pre-sharding path). Vocab-sharded engines
+        run the sharded_sample_prep executable — device argmax +
+        per-shard top-k candidates — and fetch ~(B, S·k) floats instead
+        of (B, vocab): greedy rows are BIT-IDENTICAL to np.argmax,
+        sampled rows are distribution-exact (candidate scheme, guarded;
+        anything unprovable fetches ONE replicated row through the
+        warmed "vrow" executable — the per-row parity oracle).
+
+        temps: (B,) float32 per-row temperatures (greedy rows pass 1.0 —
+        a traced input, never a compile key). n_vocab: the tokenizer
+        vocab the candidates/argmax truncate at (one compile key per
+        distinct value; rows whose sampler vocab differs fall back)."""
+        from ..ops.sharded_vocab import sharded_sample_prep
+        from .sampling import FullLogitsView, ShardedLogitsView
+
+        if not self.shard_sampling:
+            return FullLogitsView(self.fetch_logits(logits))
+        b = logits.shape[0]
+        n_shards = 1
+        for a in self._vocab_axes:
+            n_shards *= self.mesh.shape[a]
+        k = max(1, min(self.vocab_topk, self.spec.vocab_size // n_shards))
+        key = ("vprep", b, k, int(n_vocab))
+        if key not in self._steps:
+            mesh, axes = self.mesh, self._vocab_axes
+
+            def run(logits, temps, nv=int(n_vocab), kk=k):
+                return sharded_sample_prep(logits, temps, mesh, axes,
+                                           nv, kk)
+
+            run.__name__ = "sharded_sample_prep"
+            self._mint(key, jax.jit(run))
+        if temps is None:
+            temps = np.ones((b,), np.float32)
+        amax, cand_p, cand_id, guard = self._steps[key](
+            logits, jnp.asarray(temps, jnp.float32))
+        return ShardedLogitsView(
+            np.asarray(amax), np.asarray(cand_p), np.asarray(cand_id),
+            np.asarray(guard), int(n_vocab),
+            self._row_fetcher(logits), stats=self.vocab_sample_stats)
+
+    def _row_fetcher(self, logits):
+        """One replicated (vocab,) row off the sharded logits — the
+        sampled path's parity-oracle fallback. A single warmed key per
+        batch shape; the row gather is the ONLY place the serving path
+        may materialize a full-vocab vector, and only one row at a
+        time."""
+        key = ("vrow", logits.shape[0])
+        if key not in self._steps:
+            out_s = (NamedSharding(self.mesh, P()) if self.mesh is not None
+                     else None)
+            self._mint(key, jax.jit(
+                lambda l, i: lax.dynamic_index_in_dim(l, i, 0,
+                                                      keepdims=False),
+                out_shardings=out_s))
+        fn = self._steps[key]
+
+        def fetch(row: int) -> np.ndarray:
+            return np.asarray(fn(logits, jnp.int32(row)))
+
+        return fetch
+
+    def warm_sample_ops(self, logits, n_vocab: int) -> None:
+        """Compile the sharded-sampling executables (prep + row gather)
+        against one step's logits — Scheduler.warmup calls this so
+        sampled traffic mints ZERO post-warmup keys (the vprep key set
+        is bounded: one per (batch, k, vocab))."""
+        if not self.shard_sampling:
+            return
+        view = self.sample_view(logits, None, n_vocab)
+        view.row(0)  # warms the "vrow" fallback executable too
 
     # -- generation -------------------------------------------------------
 
@@ -1406,9 +1530,10 @@ class Engine:
         with their own token, gated rows pass pos[r] == seq_len and every
         write drops). Returns (greedy (B, 1+K) int32 — the target's
         argmax AFTER each segment position, computed ON DEVICE over the
-        tokenizer vocab, and the position-0 logits (B, vocab) np — what a
-        plain slot_decode_step would have returned, so non-speculating
-        rows ride the same forward and sample normally).
+        tokenizer vocab, and the position-0 logits (B, vocab) as a DEVICE
+        array — what a plain slot_decode_step would have returned, so
+        non-speculating rows ride the same forward and sample normally
+        through Engine.sample_view).
 
         The width 1 + K and n_vocab are the ONLY compile keys
         ("slot_verify"): the scheduler always pads to its configured
@@ -1441,7 +1566,11 @@ class Engine:
                                   NamedSharding(self.mesh, P(DP_AXIS)))
         greedy, logits0, self.cache = self._steps[key](
             self.params, tok, posv, self.cache)
-        return np.asarray(greedy), self.fetch_logits(logits0)
+        # logits0 stays ON DEVICE: the scheduler wraps it in a sample
+        # view (Engine.sample_view), so vocab-sharded engines never
+        # fetch the (B, vocab) array — non-speculating rows sample from
+        # the sharded candidates like any decode step
+        return np.asarray(greedy), logits0
 
     # -- prefix-cache arena steps (runtime/prefix_cache.py) ---------------
 
@@ -1781,20 +1910,33 @@ class Engine:
         if max_tokens <= 0:  # hard-cap contract, same as generate(); no
             self.pos = int(lens.max())  # D2H fetch for discarded logits
             return
-        logits_np = self.fetch_logits(logits)
 
         n_out = np.zeros(b, np.int64)
         done = np.zeros(b, bool)
-        # one host-sampler call per step (Sampler.sample_batch): the
-        # shared xorshift stream's coins are drawn in row order for live
-        # rows, token-for-token identical to per-row sample() calls.
+        # one host-sampler call per step, in row order for live rows —
+        # the shared xorshift stream's coins are drawn token-for-token
+        # identical to per-row sample() calls. On vocab-sharded engines
+        # the view serves greedy rows from the device argmax
+        # (bit-identical) and sampled rows from the candidate scheme
+        # (distribution-exact) instead of fetching (B, vocab) logits.
         # (Batched-numpy sampling was built and measured SLOWER than the
         # row loop in every branch — the negative result and the actual
         # large-dp answer, --device-sampling, are recorded in
         # sample_batch's docstring; VERDICT r3 weak #7.)
+        temps = np.full((b,), sampler.temperature if sampler.temperature
+                        else 1.0, np.float32)
+        n_vocab = int(sampler.vocab_size)
+
+        def sample_rows(lg, mask: np.ndarray) -> np.ndarray:
+            view = self.sample_view(lg, temps, n_vocab)
+            out = np.full(b, -1, np.int64)
+            for i in np.nonzero(mask)[0]:
+                out[i] = view.sample(sampler, int(i))
+            return out
+
         live0 = (np.ones(b, bool) if stop_flags is None
                  else ~np.asarray(stop_flags, bool))
-        cur = sampler.sample_batch(logits_np, live0).astype(np.int32)
+        cur = sample_rows(logits, live0).astype(np.int32)
         # sample_batch marks unselected rows -1; a pre-retired (padding)
         # row's token is still FED to the embedding gather every step, so
         # clamp it to a real id rather than lean on XLA's out-of-bounds
@@ -1829,9 +1971,8 @@ class Engine:
                     posv, NamedSharding(self.mesh, P(DP_AXIS)))
             logits, self.cache = vec_fn(
                 self.params, tokv, posv, self.cache)
-            logits_np = self.fetch_logits(logits)
             alive_mask = np.asarray([alive(i) for i in range(b)])
-            nxt = sampler.sample_batch(logits_np, alive_mask)
+            nxt = sample_rows(logits, alive_mask)
             step: list[int | None] = [None] * b
             for i in np.nonzero(alive_mask)[0]:
                 step[i] = int(nxt[i])

@@ -58,6 +58,8 @@ def estimate_decode_wire(
     q80: bool = False,
     act_bytes: int = 4,
     batch: int = 1,
+    shard_vocab: bool = False,
+    vocab_topk: int = 32,
 ) -> WireEstimate:
     """Modeled bytes each device sends per decoded token.
 
@@ -68,6 +70,10 @@ def estimate_decode_wire(
     exchange (int8 + f16 block scales = 1.0625 B/value).
     sp: the decode-attention stat merge (acc + m + l per layer).
     dp: no inter-device traffic at inference.
+    shard_vocab (ops/sharded_vocab.py): the embedding gather costs one
+    extra dim-sized all-reduce per forward, and the full-logits gather is
+    REPLACED by the candidate-summary gather (S·k probs+ids + guards per
+    row — hundreds of bytes where the logits were vocab·4).
     """
     if mesh is None:
         return WireEstimate(0.0, {})
@@ -91,7 +97,15 @@ def estimate_decode_wire(
         # exchange move 2*(n-1)/n * payload per device
         bd["tp_partial_sums"] = (spec.n_layers * reduces_per_layer
                                  * layer_fn(tp, per_reduce))
-        bd["tp_logits_gather"] = _ag(tp, spec.vocab_size * b_local * 4)
+        if shard_vocab:
+            bd["vocab_embed_psum"] = _ar(tp, spec.dim * b_local
+                                         * act_bytes)
+            k = min(vocab_topk, max(spec.vocab_size // tp, 1))
+            bd["vocab_sample_gather"] = _ag(
+                tp, b_local * (tp * k * 8 + tp * 4 + 4))
+        else:
+            bd["tp_logits_gather"] = _ag(tp,
+                                         spec.vocab_size * b_local * 4)
     if ep > 1:
         # one MoE output reduce per layer (parallel/ep_moe.py): exact mode is
         # a single all-reduce over the ep*tp group; q80 mode is a quantized

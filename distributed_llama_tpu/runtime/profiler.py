@@ -214,10 +214,38 @@ COMPILES = CompileLedger()
 
 
 def _tree_bytes(tree) -> int:
+    """PER-DEVICE live bytes of a pytree (max across devices): sharded
+    leaves count only the shard a device actually holds, replicated
+    leaves count fully on every device. This is the number the 2.42
+    GB/chip budget talks about — global ``nbytes`` would overstate a
+    tp-sharded weight tp-fold (and understate what vocab sharding
+    frees). On mesh-less engines every leaf lives whole on one device
+    and this equals the old global sum."""
     import jax
 
-    return sum(int(getattr(leaf, "nbytes", 0) or 0)
-               for leaf in jax.tree_util.tree_leaves(tree))
+    per_dev: dict = {}
+    plain = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            plain += int(getattr(leaf, "nbytes", 0) or 0)
+            continue
+        this_leaf: dict = {}
+        try:
+            for sh in shards:
+                d = sh.device.id
+                this_leaf[d] = this_leaf.get(d, 0) + int(sh.data.nbytes)
+        except Exception:  # noqa: BLE001 — deleted/donated buffers:
+            # fall back to the leaf's PER-DEVICE share (global nbytes /
+            # shard count), discarding the partial walk — adding global
+            # bytes here would inflate a per-device sum up to
+            # mesh-size-fold and shrink the auto-sizers' headroom
+            n = max(len(shards), 1)
+            plain += int(getattr(leaf, "nbytes", 0) or 0) // n
+            continue
+        for d, b in this_leaf.items():
+            per_dev[d] = per_dev.get(d, 0) + b
+    return (max(per_dev.values()) if per_dev else 0) + plain
 
 
 def device_memory_stats():
@@ -245,14 +273,26 @@ def hbm_ledger(engine, prefix_cache=None, *, block_len: int | None = None,
     logits+workspace is the modeled transient: the (B, vocab) f32 logits
     fetch plus one (B, chunk, dim) activation segment):
 
-      * ``weights_bytes``      — every param leaf (quantized tensors
-        count their packed bytes). Cached on the engine: weights never
-        change size. NOTE: thread-tier replicas SHARE weight buffers, so
-        summing this across replica blocks multi-counts one allocation —
-        the per-replica truth is kv+arena, the weights are per-process.
+      * ``weights_bytes``      — every LAYER/norm param leaf (quantized
+        tensors count their packed bytes). Cached on the engine: weights
+        never change size. NOTE: thread-tier replicas SHARE weight
+        buffers, so summing this across replica blocks multi-counts one
+        allocation — the per-replica truth is kv+arena, the weights are
+        per-process.
+      * ``vocab_bytes``        — the embedding table + logits head
+        (tok_emb/wcls), split out of weights so vocab sharding's freed
+        bytes are VISIBLE: replicated they cost the full table per
+        device, sharded 1/S of it — and the difference lands directly
+        in ``slots_addable``/``prefix_blocks_addable`` below.
       * ``kv_slot_bytes``      — the batched slot cache (all B rows).
       * ``prefix_arena_bytes`` — the radix cache's K/V block arena.
-      * ``logits_workspace_bytes`` — modeled per-step transient.
+      * ``logits_workspace_bytes`` — modeled per-step transient (a
+        vocab-sharded head fetches candidate summaries, so the modeled
+        logits transient is vocab/S there).
+
+    All categories are PER-DEVICE bytes (max across devices): sharded
+    leaves count their shard, replicated ones their full copy — the
+    chip-budget number, not the global array size.
 
     Reconciliation: ``device_bytes_in_use``/``device_bytes_limit`` from
     ``device.memory_stats()`` where the backend provides it (None on
@@ -266,10 +306,16 @@ def hbm_ledger(engine, prefix_cache=None, *, block_len: int | None = None,
     those, when the backend reports a limit."""
     spec = engine.spec
     weights = getattr(engine, "_hbm_weights_bytes", None)
-    if weights is None:
-        weights = _tree_bytes(engine.params)
+    vocab_b = getattr(engine, "_hbm_vocab_bytes", None)
+    if weights is None or vocab_b is None:
+        params = engine.params
+        vocab_b = _tree_bytes([params[k] for k in ("tok_emb", "wcls")
+                               if k in params])
+        weights = _tree_bytes({k: v for k, v in params.items()
+                               if k not in ("tok_emb", "wcls")})
         try:
             engine._hbm_weights_bytes = weights
+            engine._hbm_vocab_bytes = vocab_b
         except AttributeError:  # a read-only engine shim: skip the cache
             pass
     kv = _tree_bytes(engine.cache)
@@ -285,7 +331,14 @@ def hbm_ledger(engine, prefix_cache=None, *, block_len: int | None = None,
 
     cache_itemsize = jnp.dtype(engine.cache_dtype).itemsize
     compute_itemsize = jnp.dtype(engine.compute_dtype).itemsize
-    logits_ws = (engine.batch * spec.vocab_size * 4
+    # vocab-sharded engines keep logits vocab/S per device and fetch
+    # candidate summaries instead of the (B, vocab) array
+    n_vshards = 1
+    if getattr(engine, "shard_vocab", False):
+        mesh = getattr(engine, "mesh", None)
+        for a in getattr(engine, "_vocab_axes", ()) or ():
+            n_vshards *= mesh.shape[a]
+    logits_ws = (engine.batch * spec.vocab_size * 4 // n_vshards
                  + engine.batch * engine.prefill_chunk * spec.dim
                  * compute_itemsize)
     per_slot = (kv // engine.batch if engine.batch else 0) or (
@@ -294,11 +347,19 @@ def hbm_ledger(engine, prefix_cache=None, *, block_len: int | None = None,
     per_block = (arena // n_blocks) if n_blocks else (
         2 * spec.n_layers * spec.n_kv_heads * int(bl or 32)
         * spec.head_size * cache_itemsize)
-    accounted = weights + kv + arena + logits_ws
+    accounted = weights + vocab_b + kv + arena + logits_ws
     dev = (device_memory_stats() if device_stats is True
            else (device_stats or None))
+    if dev is not None and "bytes_in_use" not in dev:
+        # a caller supplying only a budget ({"bytes_limit": L}) gets the
+        # MODELED in-use — the accounted bytes — so headroom questions
+        # ("what does vocab sharding free?") answer on backends without
+        # allocator stats (CPU) and in what-if sizing
+        dev = {"bytes_in_use": accounted,
+               "bytes_limit": int(dev.get("bytes_limit") or 0) or None}
     out = {
         "weights_bytes": weights,
+        "vocab_bytes": vocab_b,
         "kv_slot_bytes": kv,
         "prefix_arena_bytes": arena,
         "logits_workspace_bytes": logits_ws,

@@ -1537,13 +1537,23 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                       request_deadline=request_deadline or None)
 
     def engine_factory():
-        return Engine(engine.spec, engine.params, batch=serve_batch,
+        # the launched engine's mesh carries over (tp serving — the
+        # vocab-sharded path; the api door restricts WHICH meshes reach
+        # here). Weights are the template's buffers either way; a mesh
+        # template's spec already folded kv-head replication, so the
+        # rebuild never re-replicates.
+        return Engine(engine.spec, engine.params, engine.mesh,
+                      batch=serve_batch,
                       max_seq_len=engine.seq_len,
                       compute_dtype=engine.compute_dtype,
                       cache_dtype=engine.cache_dtype,
                       use_pallas=engine.use_pallas,
                       pallas_interpret=engine.pallas_interpret,
                       activation_q80=engine.activation_q80,
+                      q80_collectives=engine.q80_collectives,
+                      shard_vocab=engine.shard_vocab,  # the template's
+                      # RESOLVED decision (auto already applied): a
+                      # rebuild must never flip the operator's choice
                       prefill_chunk=engine.prefill_chunk)
 
     n_blocks = 0

@@ -363,7 +363,8 @@ class Scheduler:
                  slo_ttft_ms: float | None = None,
                  slo_itl_ms: float | None = None,
                  draft_factory=None, draft_len: int = 0,
-                 draft_vocab: int | None = None):
+                 draft_vocab: int | None = None,
+                 sample_vocab: int | None = None):
         self.engine = engine
         # identifies THIS scheduler at the replica-level fault sites
         # (runtime/faults.py replica_raise/replica_stall): the router
@@ -410,6 +411,13 @@ class Scheduler:
         # (the host Sampler truncates there — sampler.py:69). Requests
         # whose sampler vocab differs simply never speculate.
         self.draft_vocab = int(draft_vocab or engine.spec.vocab_size)
+        # sharded-sampling vocab (vocab-sharded engines,
+        # ops/sharded_vocab.py): the TOKENIZER vocab the warmed
+        # sample-prep executable truncates at — one compile key, warmed
+        # below; requests whose sampler vocab differs take the warmed
+        # per-row parity fallback instead of minting keys
+        self.sample_vocab = int(sample_vocab or draft_vocab
+                                or engine.spec.vocab_size)
         self.draft_cache = (self.draft.new_cache()
                             if self.draft is not None else None)
         self._spec_stats = SpecStats(
@@ -710,6 +718,26 @@ class Scheduler:
                 # overstate the denominator for requests cancelled or
                 # expired mid-prefill)
 
+    def _sample_view(self, logits, rows: list[_Slot]):
+        """Wrap one forward's on-device logits for host sampling
+        (Engine.sample_view): vocab-sharded engines serve the rows from
+        the tiny argmax/candidate summary instead of a (B, vocab)
+        fetch; replicated engines (and duck-typed test engines) get the
+        classic full-logits view. temps carries each sampling row's
+        temperature as a traced input (greedy rows pass 1.0)."""
+        eng = self.engine
+        sv = getattr(eng, "sample_view", None)
+        if sv is None:
+            from .sampling import FullLogitsView
+
+            return FullLogitsView(eng.fetch_logits(logits))
+        temps = np.ones((eng.batch,), np.float32)
+        for s in rows:
+            t = getattr(s.req.sampler, "temperature", 0.0)
+            if t:
+                temps[s.idx] = t
+        return sv(logits, temps, self.sample_vocab)
+
     def _prefill_chunk(self, rows: list[_Slot],
                        width: int | None = None) -> None:
         eng = self.engine
@@ -738,7 +766,7 @@ class Scheduler:
         logits = eng.slot_prefill_chunk(tok, pos, lidx)
         if not finishing:
             return  # mid-prompt chunk: no D2H fetch at all
-        lg = eng.fetch_logits(logits)
+        view = self._sample_view(logits, finishing)
         for s in finishing:
             s.pos = len(s.req.prompt)
             if self.prefix_cache is not None:
@@ -754,7 +782,7 @@ class Scheduler:
                 # ran, nothing is emitted
                 self._finish_slot(s, "length")
                 continue
-            self._emit(s, s.req.sampler.sample(lg[s.idx]))
+            self._emit(s, view.sample(s.req.sampler, s.idx))
 
     def _decode(self, rows: list[_Slot]) -> None:
         # cancellations were reaped at the top of the iteration; a cancel
@@ -767,10 +795,10 @@ class Scheduler:
             tok[s.idx, 0] = s.last
             pos[s.idx] = s.pos
         logits = eng.slot_decode_step(tok, pos)
-        lg = eng.fetch_logits(logits)
+        view = self._sample_view(logits, live)
         for s in live:
             s.pos += 1
-            self._emit(s, s.req.sampler.sample(lg[s.idx]))
+            self._emit(s, view.sample(s.req.sampler, s.idx))
 
     # -- per-slot real-draft speculation (runtime/draft.py) ----------------
 
@@ -866,13 +894,18 @@ class Scheduler:
             drafts[s.idx] = d
             tok[s.idx, 1:1 + len(d)] = d
             s.draft_pos = s.pos + k  # the scan wrote pos..pos+k-1
-        greedy, lg0 = eng.slot_verify_step(tok, pos, self.draft_vocab)
+        greedy, logits0 = eng.slot_verify_step(tok, pos, self.draft_vocab)
         self._spec_stats.verify_forwards += 1
+        nonspec = [s for s in rows if s.idx not in drafts]
+        # position-0 sampling rides the sharded view like any decode
+        # step; built only when a non-speculating row exists (an
+        # all-speculating iteration pays no extra dispatch)
+        view0 = self._sample_view(logits0, nonspec) if nonspec else None
         for s in rows:
             d = drafts.get(s.idx)
             if d is None:
                 s.pos += 1
-                self._emit(s, s.req.sampler.sample(lg0[s.idx]))
+                self._emit(s, view0.sample(s.req.sampler, s.idx))
                 continue
             req = s.req
             m = count_accepted(d, greedy[s.idx])
@@ -1009,7 +1042,16 @@ class Scheduler:
             for w in widths:
                 eng.slot_prefill_chunk(np.zeros((eng.batch, w), np.int32),
                                        gate, np.zeros((eng.batch,), np.int32))
-            eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32), gate)
+            lg = eng.slot_decode_step(np.zeros((eng.batch, 1), np.int32),
+                                      gate)
+            # vocab-sharded engines: compile the sharded sample-prep +
+            # per-row fallback executables against the warmed decode
+            # step's logits — sampled traffic then mints ZERO
+            # post-warmup keys (the prefill/verify paths share the same
+            # batch-shaped keys)
+            warm_sample = getattr(eng, "warm_sample_ops", None)
+            if warm_sample is not None:
+                warm_sample(lg, self.sample_vocab)
             if self.draft is not None:
                 # the draft key set is planned and bounded: one prefill
                 # width, one scan shape, one verify width — compile all

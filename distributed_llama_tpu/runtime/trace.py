@@ -566,6 +566,7 @@ def _add_device_blocks(p: _Prom, summary: dict,
     hbm = summary.get("hbm")
     if hbm:
         for cat, field in (("weights", "weights_bytes"),
+                           ("vocab", "vocab_bytes"),
                            ("kv_slots", "kv_slot_bytes"),
                            ("prefix_arena", "prefix_arena_bytes"),
                            ("logits_workspace", "logits_workspace_bytes")):

@@ -192,6 +192,56 @@ def test_kvx_row_emits_valid_json():
     assert cv["fill_hit_rate"] == 1.0
 
 
+def test_vocab_row_emits_valid_json():
+    """BENCH_VOCAB=1 adds the vocab-sharding A/B row (bench._vocab_row):
+    sharded vs replicated embedding+head served over a tp=2 CPU mesh on
+    the SAME mixed greedy/sampled trace. The DETERMINISTIC acceptance
+    bars are exact: greedy TOKEN PARITY sharded vs replicated, the
+    per-chip embedding shard exactly halving the `vocab` HBM category,
+    and ZERO post-warmup compiles per variant with the ledger frozen
+    (head ms is reported, never time-asserted in CI). The committed
+    BENCH_r09.json row pins the same bars."""
+    r = _run_bench({
+        "BENCH_VOCAB": "1",
+        "BENCH_VOCAB_REQUESTS": "6",
+        "BENCH_VOCAB_TOKENS": "6",
+        "BENCH_VOCAB_STEPS": "6",
+    }, timeout=560.0)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [line for line in r.stdout.strip().splitlines()
+             if line.startswith("{")]
+    row = json.loads(lines[-1])
+    assert "error" not in row, row
+    rows = [v for v in row.get("variants", [])
+            if "vocab_shard" in v["metric"]]
+    assert len(rows) == 1, row
+    v = rows[0]
+    assert "error" not in v, v
+    assert v["token_parity"] is True, v
+    assert v["tp"] == 2
+    assert v["compiles_after_warmup_sharded"] == 0, v
+    assert v["compiles_after_warmup_replicated"] == 0, v
+    # the freed bytes are real: the embedding shard is exactly 1/tp
+    # (wcls was row-split already — both variants carry its half)
+    on, off = (v["vocab_bytes_per_chip_sharded"],
+               v["vocab_bytes_per_chip_replicated"])
+    assert 0 < on < off, v
+    assert v["value"] > 0 and v["head_sample_ms_replicated"] > 0
+    assert v["sampled_via_candidates"] > 0
+    json.dumps(v)
+
+    # committed-row bars (BENCH_r09.json): parity + zero compiles +
+    # the byte split — pinned on the artifact, not CI timing
+    art = os.path.join(REPO, "BENCH_r09.json")
+    committed = json.load(open(art))
+    cv = [x for x in committed["variants"]
+          if "vocab_shard" in x["metric"]][0]
+    assert cv["token_parity"] is True
+    assert cv["compiles_after_warmup_sharded"] == 0
+    assert (cv["vocab_bytes_per_chip_sharded"]
+            < cv["vocab_bytes_per_chip_replicated"])
+
+
 def test_spec_row_emits_valid_json():
     """BENCH_SPEC=1 adds the REAL-draft speculative-decoding row
     (bench._spec_row): self-draft vs prompt-lookup vs plain greedy on a

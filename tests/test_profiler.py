@@ -256,8 +256,11 @@ def test_hbm_ledger_matches_allocated_shapes_exactly(tiny):
     assert led["per_slot_bytes"] * eng.batch == led["kv_slot_bytes"]
     assert led["per_block_bytes"] * 16 == led["prefix_arena_bytes"]
     assert led["weights_bytes"] > 0
+    # the vocab split-out (ISSUE-15): tok_emb + wcls land in their own
+    # category and the accounted identity carries all five
+    assert led["vocab_bytes"] > 0
     assert led["accounted_bytes"] == (
-        led["weights_bytes"] + led["kv_slot_bytes"]
+        led["weights_bytes"] + led["vocab_bytes"] + led["kv_slot_bytes"]
         + led["prefix_arena_bytes"] + led["logits_workspace_bytes"])
     # CPU backend: no allocator stats — nulls, never fabricated numbers
     cpu_led = hbm_ledger(eng, pc)

@@ -703,8 +703,9 @@ def render_markdown(report: dict) -> str:
     if hbm:
         lines += ["## HBM ledger (from bench row)", "",
                   "| category | bytes |", "|---|---|"]
-        for k in ("weights_bytes", "kv_slot_bytes", "prefix_arena_bytes",
-                  "logits_workspace_bytes", "headroom_bytes"):
+        for k in ("weights_bytes", "vocab_bytes", "kv_slot_bytes",
+                  "prefix_arena_bytes", "logits_workspace_bytes",
+                  "headroom_bytes"):
             lines.append(f"| {k.removesuffix('_bytes')} | {hbm.get(k)} |")
         if hbm.get("slots_addable") is not None:
             lines.append(f"| slots_addable | {hbm['slots_addable']} |")

@@ -96,10 +96,22 @@ def write_file(path: str) -> int:
 
 def run_config(cfg: str) -> None:
     """Subprocess body: load + lower + step + account for one mesh."""
+    # BEFORE importing jax: 16 virtual CPU devices via the shared
+    # XLA_FLAGS bootstrap (utils/virtual_mesh.py) — the
+    # jax_num_cpu_devices config option does not exist on the 0.4.x
+    # jaxlib this image pins, and XLA parses the flag once per process
+    from distributed_llama_tpu.utils.virtual_mesh import \
+        ensure_virtual_cpu_devices
+
+    ensure_virtual_cpu_devices(16)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 16)
+    try:
+        jax.config.update("jax_num_cpu_devices", 16)
+    except AttributeError:  # jax 0.4.x: the XLA_FLAGS path above rules
+        pass
+    assert jax.device_count() == 16, jax.devices()
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -137,9 +149,16 @@ def run_config(cfg: str) -> None:
         return max(acc.values())
 
     dev_layer_bytes = per_device(params["layers"])
+    # vocab sharding (ops/sharded_vocab.py, ISSUE-15): tok_emb/wcls are
+    # row-split at LOAD over the mesh's vocab axes — the 533 MB/chip
+    # replicated table (VERDICT weak #3) becomes vocab/S per chip. The
+    # split is reported separately so the artifact shows the freed bytes.
+    dev_vocab_bytes = per_device(
+        {k: v for k, v in params.items() if k in ("tok_emb", "wcls")})
     dev_other_bytes = per_device(
-        {k: v for k, v in params.items() if k != "layers"})
-    dev_bytes = dev_layer_bytes + dev_other_bytes
+        {k: v for k, v in params.items()
+         if k not in ("layers", "tok_emb", "wcls")})
+    dev_bytes = dev_layer_bytes + dev_other_bytes + dev_vocab_bytes
 
     eng = Engine(spec, params, mesh, compute_dtype=jnp.float32,
                  cache_dtype=jnp.float32, max_seq_len=256)
@@ -149,6 +168,9 @@ def run_config(cfg: str) -> None:
     # compile is minutes; one compile serves both purposes)
     eng.reset()
     step_fn = eng._compiled_step(1)  # key 1 = the 1-token decode step
+    # the compile ledger (runtime/profiler.py) wraps fresh mints in a
+    # first-call watch with no .lower — AOT-lower the raw jitted callable
+    step_fn = getattr(step_fn, "_fn", step_fn)
     print(f"[{cfg}] loaded in {load_s:.0f}s; lowering decode...",
           flush=True)
     t0 = time.time()
@@ -177,10 +199,11 @@ def run_config(cfg: str) -> None:
         toks.append(int(np.argmax(eng.fetch_logits(logits)[0])))
     step_s = time.time() - t0
 
-    # full-depth extrapolation: layer bytes scale 80/4; tok_emb/wcls/rms
-    # stay as-is (tok_emb is replicated — included honestly, unlike the
-    # README's layer-only 2.42 GB/chip)
-    dev_full = dev_other_bytes + dev_layer_bytes * (FULL_LAYERS // N_LAYERS)
+    # full-depth extrapolation: layer bytes scale 80/4; the vocab shards
+    # and norms stay as-is (tok_emb used to be replicated at 524 MB/chip
+    # — now vocab/S, included honestly either way)
+    dev_full = (dev_other_bytes + dev_vocab_bytes
+                + dev_layer_bytes * (FULL_LAYERS // N_LAYERS))
 
     out = {
         "config": cfg,
@@ -191,10 +214,14 @@ def run_config(cfg: str) -> None:
         "peak_host_mb_during_load": round(stats.peak_host_bytes / 1e6, 1),
         "per_device_param_mb": round(dev_bytes / 1e6, 1),
         "per_device_layer_mb": round(dev_layer_bytes / 1e6, 1),
+        "per_device_vocab_mb": round(dev_vocab_bytes / 1e6, 1),
         "per_device_replicated_mb": round(dev_other_bytes / 1e6, 1),
+        "shard_vocab": bool(eng.shard_vocab),
+        "vocab_axes": list(getattr(eng, "_vocab_axes", ()) or ()),
         "per_device_param_gb_extrapolated_80_layers":
             round(dev_full / 1e9, 3),
         "readme_budget_gb_per_chip": 2.42,
+        "budget_met_80_layers": bool(dev_full <= 2.42e9),
         "collectives_decode_step": colls,
         "greedy_tokens": toks,
         "four_token_wall_seconds": round(step_s, 1),
@@ -223,6 +250,11 @@ def main():
         print(f"--- {cfg}")
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # run_config pins cpu in-process
+        # a preset device-count flag (an 8-device test env) would beat
+        # run_config's 16-device bootstrap — strip it
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(flags)
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", cfg],
             text=True, env=env, timeout=3600,
