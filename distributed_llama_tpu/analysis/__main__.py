@@ -8,6 +8,15 @@ beyond the baseline exist (the CI gate); 2 = analyzer failure.
 (pre-commit hooks, bare environments). The CI job runs the full analyzer
 on JAX_PLATFORMS=cpu with 8 virtual devices (entrypoints.py needs a mesh
 for the tp/ep entries).
+
+Passes, in order: Level 1 AST lint (DLG1xx), the dlrace lock-discipline
+lint (DLG3xx, runtime/apps/multihost scope), the serving-path D2H audit
+(DLG206), then — unless --no-jaxpr — the Level 2 jaxpr audit (DLG2xx).
+After the baseline split, hygiene findings are appended: DLG108 for
+baseline entries (allowlist keys or pinned fingerprints) that no longer
+match anything in the tree, DLG109 for baseline entries carrying no
+one-line justification. Hygiene findings are never themselves written
+to the baseline — --update-baseline prunes/annotates instead.
 """
 
 from __future__ import annotations
@@ -15,22 +24,79 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from collections import Counter
 
 from .ast_lint import lint_package
-from .findings import (format_github, format_json, format_text,
+from .findings import (Finding, format_github, format_json, format_text,
                        load_baseline, sort_findings, split_by_baseline,
-                       write_baseline)
+                       unjustified_keys, write_baseline)
+from .race_lint import race_lint_package
+from .serving_d2h import audit_serving_path
 
 PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
 
+def gather_findings(baseline: dict, *, no_jaxpr: bool = False,
+                    pkg_dir: str = PKG_DIR,
+                    ) -> tuple[list[Finding], dict[str, str]]:
+    """Everything the gate judges, pre-baseline-split: AST lint, dlrace,
+    serving-path D2H, and (unless no_jaxpr) the jaxpr audit. Shared by
+    the CLI and the pytest gates so they cannot drift. Raises on analyzer
+    failure (SyntaxError from the lints, anything from the audit)."""
+    prefix = "distributed_llama_tpu/"
+    findings = lint_package(pkg_dir, prefix=prefix)
+    findings.extend(race_lint_package(pkg_dir, prefix=prefix))
+    findings.extend(audit_serving_path(pkg_dir, prefix=prefix))
+    fingerprints: dict[str, str] = dict(baseline.get("fingerprints", {}))
+    if not no_jaxpr:
+        # the virtual mesh must be configured before jax initializes —
+        # same convention as tests/conftest.py so the tp/ep entries exist
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ..utils.virtual_mesh import ensure_virtual_cpu_devices
+
+        ensure_virtual_cpu_devices()
+        from .jaxpr_audit import audit_all
+
+        jaxpr_findings, fingerprints = audit_all(
+            baseline.get("fingerprints", {}))
+        findings.extend(jaxpr_findings)
+    return findings, fingerprints
+
+
+# rules that describe the BASELINE's own hygiene (or embed old->new state
+# in their message) — they can never be allowlist keys
+HYGIENE_RULES = ("DLG108", "DLG109", "DLG204")
+
+
+def hygiene_findings(findings: list[Finding], baseline: dict) -> list[Finding]:
+    """DLG108 stale allowlist keys + DLG109 unjustified entries. Stale
+    fingerprints are DLG108 too, emitted by audit_all (it knows which
+    entries were mesh-skipped rather than deleted)."""
+    out: list[Finding] = []
+    leftover = (Counter(baseline.get("findings", []))
+                - Counter(f.key() for f in findings))
+    for key, n in sorted(leftover.items()):
+        extra = f" (x{n})" if n > 1 else ""
+        out.append(Finding(
+            "DLG108", "warning", "<baseline>", 0,
+            f"stale baseline: allowlist entry matches no current site"
+            f"{extra}: `{key}` — prune with --update-baseline"))
+    for key in unjustified_keys(baseline):
+        out.append(Finding(
+            "DLG109", "warning", "<baseline>", 0,
+            f"baseline entry lacks a one-line justification: `{key}` — "
+            "every allowlisted finding is a reviewed decision; write "
+            "down why"))
+    return out
+
+
 def run(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distributed_llama_tpu.analysis",
         description="dlgrind: JAX-aware static analysis (AST lint + "
-                    "jaxpr audit)")
+                    "dlrace lock-discipline lint + jaxpr audit)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 on findings not in the baseline (CI gate)")
     ap.add_argument("--format", choices=("text", "github", "json"),
@@ -45,33 +111,17 @@ def run(argv: list[str] | None = None) -> int:
                     help="also print findings the baseline accepts")
     args = ap.parse_args(argv)
 
+    baseline = load_baseline(args.baseline)
     try:
-        findings = lint_package(PKG_DIR, prefix="distributed_llama_tpu/")
+        findings, fingerprints = gather_findings(baseline,
+                                                 no_jaxpr=args.no_jaxpr)
     except SyntaxError as e:
         print(f"analyzer failed to parse source: {e}", file=sys.stderr)
         return 2
-
-    baseline = load_baseline(args.baseline)
-    fingerprints: dict[str, str] = dict(baseline.get("fingerprints", {}))
-
-    if not args.no_jaxpr:
-        # the virtual mesh must be configured before jax initializes —
-        # same convention as tests/conftest.py so the tp/ep entries exist
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        from ..utils.virtual_mesh import ensure_virtual_cpu_devices
-
-        ensure_virtual_cpu_devices()
-        try:
-            from .jaxpr_audit import audit_all
-
-            jaxpr_findings, fingerprints = audit_all(
-                baseline.get("fingerprints", {}))
-        except Exception as e:  # analyzer crash, NOT a gate failure —
-            # keep exit code 2 distinguishable from "new findings" (1)
-            print(f"jaxpr audit failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-            return 2
-        findings.extend(jaxpr_findings)
+    except Exception as e:  # analyzer crash, NOT a gate failure —
+        # keep exit code 2 distinguishable from "new findings" (1)
+        print(f"analysis failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
 
     new, accepted = split_by_baseline(findings, baseline)
 
@@ -86,14 +136,16 @@ def run(argv: list[str] | None = None) -> int:
                   "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
                   file=sys.stderr)
             return 2
-        # DLG204 drift findings embed the old->new hashes in their message
-        # — as allowlist keys they could never match again. Fingerprints
-        # are re-pinned via their own map; keep them out of the findings.
-        pinned = [f for f in findings if f.rule != "DLG204"]
-        write_baseline(args.baseline, pinned, fingerprints)
+        pinned = [f for f in findings if f.rule not in HYGIENE_RULES]
+        write_baseline(args.baseline, pinned, fingerprints,
+                       baseline.get("justifications", {}))
         print(f"baseline updated: {len(pinned)} finding(s), "
               f"{len(fingerprints)} fingerprint(s) -> {args.baseline}")
         return 0
+
+    # hygiene findings join AFTER the split/update paths: they describe
+    # the baseline itself, so they can never be accepted by it
+    new.extend(hygiene_findings(findings, baseline))
 
     to_show = sort_findings(new)
     if args.format == "github":

@@ -31,9 +31,12 @@ import re
 SEVERITIES = ("error", "warning", "info")
 
 # inline suppression: `# dlgrind: ignore[DLG101]`, `ignore[DLG101,DLG203]`,
-# or a bare `# dlgrind: ignore` (suppresses every rule on that line)
+# or a bare `# dlgrind: ignore` (suppresses every rule on that line).
+# The dlrace (DLG3xx) family reuses the same syntax under its own marker:
+# `# dlrace: ignore[DLG305]` — one mechanism, two spellings, so a lock-
+# discipline suppression reads as what it is.
 _IGNORE_RE = re.compile(
-    r"#\s*dlgrind:\s*ignore(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
+    r"#\s*dl(?:grind|race):\s*ignore(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,28 +88,52 @@ def is_suppressed(f: Finding, supp: dict[int, set[str] | None]) -> bool:
 
 
 def load_baseline(path: str) -> dict:
-    """{"findings": [key, ...], "fingerprints": {entry: hex}} (both optional
-    in the file; absent file = empty baseline, i.e. everything is new).
-    Duplicate keys in "findings" are meaningful — one entry per accepted
-    site (see module docstring)."""
+    """{"findings": [key, ...], "fingerprints": {entry: hex},
+    "justifications": {key: one-line reason}} (all optional in the file;
+    absent file = empty baseline, i.e. everything is new). Duplicate keys
+    in "findings" are meaningful — one entry per accepted site (see
+    module docstring). Every distinct findings key must carry a
+    justification: an allowlist entry is a reviewed decision, and the
+    baseline is where the decision's one-line rationale lives."""
     try:
         with open(path, encoding="utf-8") as f:
             raw = json.load(f)
     except FileNotFoundError:
-        return {"findings": [], "fingerprints": {}}
+        return {"findings": [], "fingerprints": {}, "justifications": {}}
     return {"findings": list(raw.get("findings", [])),
-            "fingerprints": dict(raw.get("fingerprints", {}))}
+            "fingerprints": dict(raw.get("fingerprints", {})),
+            "justifications": dict(raw.get("justifications", {}))}
 
 
 def write_baseline(path: str, findings: list[Finding],
-                   fingerprints: dict[str, str]) -> None:
+                   fingerprints: dict[str, str],
+                   justifications: dict[str, str] | None = None) -> None:
+    keys = sorted(f.key() for f in findings)  # one entry PER SITE
+    just = justifications or {}
     data = {
-        "findings": sorted(f.key() for f in findings),  # one entry PER SITE
+        "findings": keys,
         "fingerprints": dict(sorted(fingerprints.items())),
+        # carry forward only justifications for keys that still exist;
+        # keys without one get an explicit TODO so the gap is visible in
+        # review instead of silently absent
+        "justifications": {k: just.get(k, "TODO: justify this entry")
+                           for k in sorted(set(keys))},
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=True)
         f.write("\n")
+
+
+def unjustified_keys(baseline: dict) -> list[str]:
+    """Distinct baseline findings keys with no (or placeholder) one-line
+    justification — the gate treats these as findings (DLG109)."""
+    just = baseline.get("justifications", {})
+    out = []
+    for key in sorted(set(baseline.get("findings", []))):
+        reason = str(just.get(key, "")).strip()
+        if not reason or reason.startswith("TODO"):
+            out.append(key)
+    return out
 
 
 def split_by_baseline(

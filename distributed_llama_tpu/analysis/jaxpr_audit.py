@@ -247,7 +247,7 @@ def audit_all(baseline_fingerprints: dict[str, str] | None = None,
         for name in sorted(set(baseline_fingerprints) - set(fingerprints)
                            - skipped):
             findings.append(Finding(
-                "DLG204", "warning", f"<entry:{name}>", 0,
-                "baseline pins a fingerprint for an entry point that no "
-                "longer exists — prune with --update-baseline"))
+                "DLG108", "warning", f"<entry:{name}>", 0,
+                "stale baseline: pinned fingerprint for an entry point "
+                "that no longer exists — prune with --update-baseline"))
     return findings, fingerprints

@@ -1,0 +1,119 @@
+"""DLG206: device-to-host transfers on the per-token serving path.
+
+DLG107 makes every host-device boundary sync in runtime code a visible
+decision; this pass adds the dimension that matters for ROADMAP item 4
+(the dispatch-bound host loop): WHICH of those syncs sit on the per-token
+serving path. A `.item()` in a save/load helper costs nothing; the same
+call reachable from the scheduler's step body executes once per decode
+iteration across the whole batch and is exactly the host work a
+multi-token dispatch redesign must move or batch.
+
+Mechanism: a leaf-name call graph over the runtime tier (plus
+sampler.py), BFS-reachable from the per-token roots below, then the
+DLG107 taint machinery re-run per file — any DLG107-shaped sync whose
+line falls inside a reachable function is re-emitted as DLG206. The
+call graph matches by attribute/function leaf name, so `self.engine.
+slot_decode_step(...)` reaches `Engine.slot_decode_step` without type
+inference; over-approximation is fine (a false edge can only ADD a
+finding that DLG107 already judged a real sync).
+
+The currently-accepted host-sampling sites are baselined with
+justifications — the rule lands green but the per-token sync budget is
+now enumerated in one place (`baseline.json`, keys starting DLG206).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .ast_lint import _dotted, iter_package_files, lint_source
+from .findings import Finding
+
+# files the call graph covers (package-relative, posix)
+SERVING_FILES = ("runtime/", "sampler.py")
+
+# per-token serving roots: (file suffix, function leaf name). The
+# scheduler step body is the continuous-batching inner loop; the legacy
+# streaming generators are the apps/ serving path for single requests.
+SERVING_ROOTS = (
+    ("runtime/scheduler.py", "_step_body"),
+    ("runtime/engine.py", "generate"),
+    ("runtime/engine.py", "generate_lookup_stream"),
+    ("runtime/engine.py", "generate_draft_sampled_stream"),
+)
+
+
+def _functions_with_spans(tree: ast.Module):
+    """(leaf name, lineno, end_lineno, called leaf names) per function."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                leaf = _dotted(sub.func).rsplit(".", 1)[-1]
+                if leaf:
+                    calls.add(leaf)
+        out.append((node.name, node.lineno,
+                    getattr(node, "end_lineno", node.lineno), calls))
+    return out
+
+
+def audit_serving_path(pkg_root: str, prefix: str = "") -> list[Finding]:
+    # parse the serving tier once
+    table: dict[str, list] = {}       # file -> [(name, lo, hi, calls)]
+    by_name: dict[str, list] = {}     # leaf name -> [(file, lo, hi, calls)]
+    sources: dict[str, str] = {}
+    for rel in iter_package_files(pkg_root):
+        scope = rel.split("distributed_llama_tpu/", 1)[-1]
+        if not (scope.startswith(SERVING_FILES[0])
+                or scope == SERVING_FILES[1]):
+            continue
+        with open(os.path.join(pkg_root, rel), encoding="utf-8") as f:
+            src = f.read()
+        sources[rel] = src
+        fns = _functions_with_spans(ast.parse(src, filename=rel))
+        table[rel] = fns
+        for name, lo, hi, calls in fns:
+            by_name.setdefault(name, []).append((rel, lo, hi, calls))
+
+    # BFS by leaf name from the roots
+    reachable: set[tuple[str, str]] = set()    # (file, fn name)
+    frontier: list[tuple[str, str, set]] = []
+    for root_file, root_fn in SERVING_ROOTS:
+        for rel, fns in table.items():
+            if not rel.endswith(root_file):
+                continue
+            for name, lo, hi, calls in fns:
+                if name == root_fn:
+                    frontier.append((rel, name, calls))
+    while frontier:
+        rel, name, calls = frontier.pop()
+        if (rel, name) in reachable:
+            continue
+        reachable.add((rel, name))
+        for callee in calls:
+            for crel, lo, hi, ccalls in by_name.get(callee, []):
+                if (crel, callee) not in reachable:
+                    frontier.append((crel, callee, ccalls))
+
+    # re-run the DLG107 machinery and keep syncs inside reachable spans.
+    # nested defs share the enclosing function's span — containment over
+    # the SMALLEST enclosing reachable function keeps it precise enough.
+    findings: list[Finding] = []
+    for rel, src in sources.items():
+        spans = [(lo, hi) for (name, lo, hi, _) in table[rel]
+                 if (rel, name) in reachable]
+        if not spans:
+            continue
+        for f in lint_source(prefix + rel, src):
+            if f.rule != "DLG107":
+                continue
+            if any(lo <= f.line <= hi for lo, hi in spans):
+                findings.append(Finding(
+                    "DLG206", "info", f.file, f.line,
+                    f"{f.message} — on the per-token serving path (runs "
+                    "every decode iteration)"))
+    return findings

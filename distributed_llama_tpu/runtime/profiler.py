@@ -137,7 +137,7 @@ class CompileLedger:
         self.total_ms = 0.0
         self.after_warmup = 0      # compiles on an already-warm engine
         self.key_overflow = 0
-        self.by_key: dict[str, dict] = {}
+        self.by_key: dict[str, dict] = {}  # dlrace: guarded-by(self._lock)
 
     def watch(self, engine, key, fn):
         """Wrap one freshly-jitted callable (the ``Engine._mint`` hook)."""
@@ -600,7 +600,7 @@ class DeviceTimeStats:
         self.window = int(window)
         self.max_keys = int(max_keys)
         self._lock = threading.Lock()
-        self._hist: dict[str, object] = {}
+        self._hist: dict[str, object] = {}  # dlrace: guarded-by(self._lock)
         self.overflow = 0
 
     def record(self, name: str, ms: float) -> None:
@@ -644,9 +644,9 @@ class SyncStats:
 
         self.window = int(window)
         self._lock = threading.Lock()
-        self._sync = deque(maxlen=self.window)
-        self._device = deque(maxlen=self.window)
-        self._wall = deque(maxlen=self.window)
+        self._sync = deque(maxlen=self.window)  # dlrace: guarded-by(self._lock)
+        self._device = deque(maxlen=self.window)  # dlrace: guarded-by(self._lock)
+        self._wall = deque(maxlen=self.window)  # dlrace: guarded-by(self._lock)
 
     def record(self, sync_ms: float, device_ms: float,
                wall_ms: float | None = None) -> None:
@@ -704,7 +704,7 @@ class Profiler:
         self.device_time = DeviceTimeStats()
         self.sync = SyncStats()     # sampled sync/compute split (dlwire)
         self._lock = threading.Lock()
-        self._busy = False          # the one process-global trace slot
+        self._busy = False  # dlrace: guarded-by(self._lock)
 
     # -- the /admin/profile body ----------------------------------------
 

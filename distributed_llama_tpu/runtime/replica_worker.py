@@ -183,7 +183,7 @@ class ReplicaServer:
         self._fault_key = fault_key
         self._profile_dir = profile_dir  # RMSG_PROFILE capture home
         self._sup_lock = threading.RLock()
-        self.sup = sup_factory()
+        self.sup = sup_factory()  # dlrace: guarded-by(self._sup_lock)
         # cross-replica KV block transfer (runtime/kv_transfer.py): this
         # worker serves sibling QUERY/FETCH connections as a donor and
         # runs its own fills when a submit carries donor coordinates.

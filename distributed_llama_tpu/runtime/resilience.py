@@ -139,18 +139,18 @@ class EngineSupervisor:
         # never loses those counts); only ancient generations past the
         # cap are compressed into the _carry snapshot, long after any
         # writer can exist
-        self._dead_stats: list = []
-        self._carry = {k: 0 for k in _COUNTER_KEYS}
+        self._dead_stats: list = []  # dlrace: guarded-by(self._state_lock)
+        self._carry = {k: 0 for k in _COUNTER_KEYS}  # dlrace: guarded-by(self._state_lock)
         self._stop = False
-        self._gen = 0
-        self._state = READY
-        self._sched = self._make_sched(engine_factory())
+        self._gen = 0  # dlrace: guarded-by(self._state_lock)
+        self._state = READY  # dlrace: guarded-by(self._state_lock)
+        self._sched = self._make_sched(engine_factory())  # dlrace: guarded-by(self._state_lock)
         # compile the serving executables BEFORE the watchdog exists: a
         # first-step compile must never read as a stall (see
         # Scheduler.warmup) and /readyz must mean "will serve promptly"
         self._sched.warmup()
         self._loop_threads: dict[int, threading.Thread] = {}
-        self._rebuild_thread: threading.Thread | None = None
+        self._rebuild_thread: threading.Thread | None = None  # dlrace: guarded-by(self._state_lock)
         self._start_loop(self._sched, self._gen)
         self._watchdog_thread = threading.Thread(
             target=self._watchdog, name="dllama-watchdog", daemon=True)

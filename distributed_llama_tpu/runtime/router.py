@@ -225,7 +225,7 @@ class ShadowPrefixIndex:
     def __init__(self, block_len: int = 32, cap: int = 4096):
         self.block_len = int(block_len)
         self.cap = int(cap)
-        self._paths: OrderedDict[tuple, None] = OrderedDict()
+        self._paths: OrderedDict[tuple, None] = OrderedDict()  # dlrace: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def publish(self, tokens: list[int]) -> None:
@@ -369,18 +369,18 @@ class RemoteReplicaHandle:
         self._min_uptime = float(min_uptime)
         self._lock = threading.RLock()
         self._closed = False
-        self._broken = False
-        self._spawn_fails = 0
+        self._broken = False  # dlrace: guarded-by(self._lock)
+        self._spawn_fails = 0  # dlrace: guarded-by(self._lock)
         self._health = {"ready": False, "state": "starting", "load": 0,
-                        "busy": False, "recoveries": 0}
-        self._last_counters = {k: 0 for k in _COUNTER_KEYS}
-        self._carry = {k: 0 for k in _COUNTER_KEYS}
-        self._last_summary: dict | None = None
+                        "busy": False, "recoveries": 0}  # dlrace: guarded-by(self._lock)
+        self._last_counters = {k: 0 for k in _COUNTER_KEYS}  # dlrace: guarded-by(self._lock)
+        self._carry = {k: 0 for k in _COUNTER_KEYS}  # dlrace: guarded-by(self._lock)
+        self._last_summary: dict | None = None  # dlrace: guarded-by(self._lock)
         # fold epoch: bumped by every death fold so a counter snapshot
         # RPC'd from the dying generation can never be re-installed into
         # the caches afterwards (it would be folded a second time on the
         # next death — double-counting /stats totals)
-        self._fold_epoch = 0
+        self._fold_epoch = 0  # dlrace: guarded-by(self._lock)
         if proc is not None:
             proc.spawn()
             try:
@@ -395,7 +395,7 @@ class RemoteReplicaHandle:
         else:
             self.client = WorkerClient(address[0], address[1],
                                        io_timeout=io_timeout)
-        self._spawned_at = time.perf_counter()
+        self._spawned_at = time.perf_counter()  # dlrace: guarded-by(self._lock)
         self._refresh_health()
         self._monitor_thread = threading.Thread(
             target=self._monitor, name=f"dllama-replica-proc-r{rid}",
@@ -532,6 +532,13 @@ class RemoteReplicaHandle:
             # is an ADMIN decision, not a client disconnect side effect)
             pass
         self.client.close()
+        # the monitor checks _closed every poll, but a death fold can hold
+        # it in respawn backoff for a while — bound the wait rather than
+        # let interpreter teardown race its health probes into a closed
+        # client (join(None) could hang close() behind a full breaker run)
+        monitor = self._monitor_thread
+        if monitor.is_alive() and monitor is not threading.current_thread():
+            monitor.join(timeout=min(timeout, 5.0) + self._poll)
 
     def summary(self) -> dict:
         with self._lock:
@@ -899,8 +906,8 @@ class Router:
         # window (x(1+retry_budget) the documented bound)
         self._request_deadline = sup_kwargs.get("request_deadline")
         self._lock = threading.RLock()  # placement + breaker + affinity
-        self._rr = 0
-        self._affinity: OrderedDict[str, int] = OrderedDict()
+        self._rr = 0  # dlrace: guarded-by(self._lock)
+        self._affinity: OrderedDict[str, int] = OrderedDict()  # dlrace: guarded-by(self._lock)
         self._closed = False
         # replicas build sequentially: each EngineSupervisor warms its
         # executables before returning, and the XLA compile cache makes

@@ -428,7 +428,7 @@ class Scheduler:
         # in-flight forward (measured: mutex-taking submits stalled a
         # 2.8 s arrival trace to 8.5 s behind back-to-back steps — lock
         # handoff is not FIFO)
-        self._queue: deque[ServeRequest] = deque()
+        self._queue: deque[ServeRequest] = deque()  # dlrace: guarded-by(self._mutex)
         self._mutex = threading.RLock()  # step()/exclusive() mutual excl.
         self._wake = threading.Event()
         self.stats = ServeStats()
@@ -439,14 +439,14 @@ class Scheduler:
         # when no draft: a tier must not lose the family to a launch flag)
         self._thread: threading.Thread | None = None
         self._stop = False
-        self._closed = False
+        self._closed = False  # dlrace: guarded-by(self._mutex)
         # watchdog heartbeat: perf_counter when the CURRENT step body
         # entered, None while idle/between steps. Written only by the
         # stepping thread; read lock-free by the supervisor's watchdog
         # (a float store is atomic under the GIL) — a mutex-holding
         # borrow (exclusive()) therefore never looks like a stall.
-        self._step_t0: float | None = None
-        self._rid = 0
+        self._step_t0: float | None = None  # dlrace: guarded-by(self._mutex)
+        self._rid = 0  # dlrace: guarded-by(self._rid_lock)
         self._rid_lock = threading.Lock()
 
     # -- submission --------------------------------------------------------
@@ -656,7 +656,7 @@ class Scheduler:
                                 "retryable": code != "deadline"}):
             self.stats.requests_expired += 1
 
-    def _admit(self) -> None:
+    def _admit(self) -> None:  # dlrace: holds(self._mutex)
         now = time.perf_counter()
         free = [s for s in self.slots if s.req is None]
         while free and self._queue:

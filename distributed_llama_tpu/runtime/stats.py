@@ -189,7 +189,7 @@ class StepTimelineStats:
         self.window = int(window)
         self.max_keys = int(max_keys)
         self._lock = threading.Lock()
-        self._hist: dict[tuple, object] = {}
+        self._hist: dict[tuple, object] = {}  # dlrace: guarded-by(self._lock)
         self.overflow = 0  # samples dropped past max_keys
 
     def record(self, decode_rows: int, prefill_rows: int, chunk: int,
@@ -327,10 +327,10 @@ class WireStats:
         self.recent = int(recent)
         self._lock = threading.Lock()
         # peer -> {"tx"|"rx" -> {kind_name -> [frames, bytes]}}
-        self._counts: dict[int, dict] = {}
-        self._rtt: dict[int, object] = {}       # peer -> deque of ms
-        self._offset: dict[int, float] = {}     # peer -> seconds (at best rtt)
-        self._best_rtt: dict[int, float] = {}
+        self._counts: dict[int, dict] = {}  # dlrace: guarded-by(self._lock)
+        self._rtt: dict[int, object] = {}  # dlrace: guarded-by(self._lock)
+        self._offset: dict[int, float] = {}  # dlrace: guarded-by(self._lock)
+        self._best_rtt: dict[int, float] = {}  # dlrace: guarded-by(self._lock)
         self.key_overflow = 0
 
     def account(self, peer: int, kind: str, direction: str,
@@ -532,7 +532,7 @@ class KVTransferStats:
         from collections import deque
 
         # whole-fill wall ms (connect -> last block imported)
-        self.transfer_ms = deque(maxlen=1000)
+        self.transfer_ms = deque(maxlen=1000)  # dlrace: guarded-by(self.lock)
         self.wire = WireStats()
         # counter mutations ride this lock (concurrent fills/donor
         # connections all write here; += on a dataclass int is a

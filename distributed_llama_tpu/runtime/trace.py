@@ -134,12 +134,12 @@ class TraceSink:
         self.max_bytes = int(max_bytes)
         self.max_files = int(max_files)
         self._lock = threading.Lock()
-        self._fh = None
-        self._n = 0
-        self._seq = 0
+        self._fh = None  # dlrace: guarded-by(self._lock)
+        self._n = 0  # dlrace: guarded-by(self._lock)
+        self._seq = 0  # dlrace: guarded-by(self._lock)
         os.makedirs(directory, exist_ok=True)
 
-    def _open_next(self) -> None:
+    def _open_next(self) -> None:  # dlrace: holds(self._lock)
         if self._fh is not None:
             try:
                 self._fh.close()
@@ -193,9 +193,9 @@ class Tracer:
         self.decode_every = 8     # decode progress event cadence (tokens)
         self.sample = 1.0         # sink sampling rate (ring records all)
         self._capacity = 8192
-        self._ring: deque = deque(maxlen=self._capacity)
+        self._ring: deque = deque(maxlen=self._capacity)  # dlrace: guarded-by(self._lock)
         self._lock = threading.Lock()
-        self._next_id = 0
+        self._next_id = 0  # dlrace: guarded-by(self._lock)
         self._sink: TraceSink | None = None
         self.steps = StepTimelineStats()
         self.dropped = 0          # ring evictions are implicit; this
@@ -206,8 +206,8 @@ class Tracer:
         # thread's latency with --trace-buffer). Span events are
         # per-lifecycle (a handful per request), so a small lock here
         # never touches the per-step hot path (tid 0 skips it).
-        self._spans: "dict[int, list]" = {}
-        self._span_order: deque = deque()   # insertion order for eviction
+        self._spans: "dict[int, list]" = {}  # dlrace: guarded-by(self._span_lock)
+        self._span_order: deque = deque()   # dlrace: guarded-by(self._span_lock)
         self._span_lock = threading.Lock()
         self._anchor()
 

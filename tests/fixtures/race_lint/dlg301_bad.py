@@ -1,0 +1,26 @@
+"""Bad fixture: DLG301 — the close/submit TOCTOU, as it shipped.
+
+close() flips the flag and drains the queue without the mutex; submit()
+checks the flag lock-free and appends after the check. A request admitted
+between close()'s flag write and its drain is never aborted — the caller
+waits forever on a future nobody will complete.
+"""
+import threading
+from collections import deque
+
+
+class Scheduler:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._queue = deque()  # dlrace: guarded-by(self._mutex)
+        self._closed = False  # dlrace: guarded-by(self._mutex)
+
+    def submit(self, req):
+        if self._closed:
+            raise RuntimeError("closed")
+        self._queue.append(req)  # DLG301: append outside the mutex
+
+    def close(self):
+        self._closed = True  # DLG301: flag write outside the mutex
+        while self._queue:
+            self._queue.popleft().abort()  # DLG301: drain outside the mutex

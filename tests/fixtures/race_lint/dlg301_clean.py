@@ -1,0 +1,34 @@
+"""Clean fixture: DLG301 — the same scheduler, lock-disciplined. Also
+exercises the two caller-holds conventions (`_locked` suffix, def-line
+`# dlrace: holds(...)`) that must NOT trip the rule."""
+import threading
+from collections import deque
+
+
+class Scheduler:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._queue = deque()  # dlrace: guarded-by(self._mutex)
+        self._closed = False  # dlrace: guarded-by(self._mutex)
+
+    def submit(self, req):
+        with self._mutex:
+            if self._closed:
+                raise RuntimeError("closed")
+            self._queue.append(req)
+
+    def close(self):
+        with self._mutex:
+            self._closed = True
+            drained = list(self._queue)
+            self._queue.clear()
+        for req in drained:  # local snapshot: iteration needs no lock
+            req.abort()
+
+    def _admit_locked(self, req):
+        # `_locked` suffix: the caller owns every class guard
+        self._queue.append(req)
+
+    def _drain(self):  # dlrace: holds(self._mutex)
+        while self._queue:
+            self._queue.popleft()

@@ -1,0 +1,17 @@
+"""Bad fixture: DLG302 — the watchdog-vs-capture stall shape: a
+multi-second sleep inside the critical section every health probe and
+stats reader also needs."""
+import threading
+import time
+
+
+class Profiler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy = False  # dlrace: guarded-by(self._lock)
+
+    def capture(self, ms):
+        with self._lock:
+            self._busy = True
+            time.sleep(ms / 1000.0)  # DLG302: every reader stalls behind it
+            self._busy = False

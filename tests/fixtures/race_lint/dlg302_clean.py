@@ -1,0 +1,34 @@
+"""Clean fixture: DLG302 — the slot is claimed under the lock, the slow
+work runs outside it. Also shows the dedicated-I/O-mutex shape (a lock
+that exists precisely to serialize a blocking send) which is deliberately
+un-annotated and must not trip the rule."""
+import threading
+import time
+
+
+class Profiler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy = False  # dlrace: guarded-by(self._lock)
+
+    def capture(self, ms):
+        with self._lock:
+            if self._busy:
+                return None
+            self._busy = True
+        try:
+            time.sleep(ms / 1000.0)  # outside the critical section
+        finally:
+            with self._lock:
+                self._busy = False
+        return ms
+
+
+class Client:
+    def __init__(self, sock):
+        self.sock = sock
+        self._send_lock = threading.Lock()  # dedicated I/O mutex: no guard
+
+    def send(self, payload):
+        with self._send_lock:
+            self.sock.sendall(payload)  # serializing the send is the POINT

@@ -1,0 +1,18 @@
+"""Bad fixture: DLG303 — the half-open probe leak, as it shipped: the
+probe acquired the breaker lock, `ping()` raised, and the release on the
+fall-through line never ran — every later submit spun on a lock nobody
+held."""
+import threading
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.open_until = 0.0
+
+    def probe(self, client):
+        self._lock.acquire()  # DLG303: ping() below can raise
+        ok = client.ping()
+        if ok:
+            self.open_until = 0.0
+        self._lock.release()

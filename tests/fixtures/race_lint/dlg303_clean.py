@@ -1,0 +1,22 @@
+"""Clean fixture: DLG303 — both accepted shapes: acquire immediately
+followed by try/finally release, and the context-manager form."""
+import threading
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.open_until = 0.0
+
+    def probe(self, client):
+        self._lock.acquire()
+        try:
+            ok = client.ping()
+            if ok:
+                self.open_until = 0.0
+        finally:
+            self._lock.release()
+
+    def probe_with(self, client):
+        with self._lock:
+            return client.ping()

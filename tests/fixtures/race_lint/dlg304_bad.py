@@ -1,0 +1,26 @@
+"""Bad fixture: DLG304 — the unjoined `_rebuild` thread: close() joined
+the watchdog but forgot the in-flight rebuild; interpreter teardown ran
+the rebuild callback into a half-destroyed module dict."""
+import threading
+
+
+class Supervisor:
+    def __init__(self):
+        self._watchdog_thread = threading.Thread(target=self._watch,
+                                                 daemon=True)
+        self._watchdog_thread.start()
+        self._rebuild_thread = None
+
+    def kick_rebuild(self):
+        self._rebuild_thread = threading.Thread(target=self._rebuild,
+                                                daemon=True)
+        self._rebuild_thread.start()
+
+    def _watch(self):
+        pass
+
+    def _rebuild(self):
+        pass
+
+    def close(self):
+        self._watchdog_thread.join(timeout=5.0)  # rebuild never joined

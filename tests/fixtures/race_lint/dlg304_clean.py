@@ -1,0 +1,36 @@
+"""Clean fixture: DLG304 — both accepted join shapes (direct receiver and
+local snapshot taken under the lock), plus a fire-and-forget LOCAL thread
+which is out of the rule's scope by design."""
+import threading
+
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._watchdog_thread = threading.Thread(target=self._watch,
+                                                 daemon=True)
+        self._watchdog_thread.start()
+        self._rebuild_thread = None  # dlrace: guarded-by(self._lock)
+
+    def kick_rebuild(self):
+        with self._lock:
+            self._rebuild_thread = threading.Thread(target=self._rebuild,
+                                                    daemon=True)
+            self._rebuild_thread.start()
+
+    def flash(self):
+        t = threading.Thread(target=self._watch, daemon=True)
+        t.start()  # local fire-and-forget: not an instance attribute
+
+    def _watch(self):
+        pass
+
+    def _rebuild(self):
+        pass
+
+    def close(self):
+        self._watchdog_thread.join(timeout=5.0)
+        with self._lock:
+            rebuild = self._rebuild_thread
+        if rebuild is not None:
+            rebuild.join(timeout=5.0)  # snapshot alias join counts

@@ -1,0 +1,19 @@
+"""Bad fixture: DLG305 — the scan crash, as it shipped: the stats
+endpoint iterated the live window while the step loop appended.
+`RuntimeError: deque mutated during iteration`, once every few thousand
+requests under load."""
+import threading
+from collections import deque
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=512)  # dlrace: guarded-by(self._lock)
+        self._by_key = {}  # dlrace: guarded-by(self._lock)
+
+    def snapshot(self):
+        out = [r for r in self._window]  # DLG305: comprehension, no lock
+        for key, val in self._by_key.items():  # DLG305: for over .items()
+            out.append((key, val))
+        return sorted(self._window)  # DLG305: consuming call, no lock

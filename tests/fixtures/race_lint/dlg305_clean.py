@@ -1,0 +1,18 @@
+"""Clean fixture: DLG305 — snapshot under the lock, iterate the local."""
+import threading
+from collections import deque
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=512)  # dlrace: guarded-by(self._lock)
+        self._by_key = {}  # dlrace: guarded-by(self._lock)
+
+    def snapshot(self):
+        with self._lock:
+            window = list(self._window)
+            items = list(self._by_key.items())
+        out = [r for r in window]
+        out.extend(items)
+        return sorted(out, key=str)

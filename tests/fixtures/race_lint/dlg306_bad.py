@@ -1,0 +1,25 @@
+"""Bad fixture: DLG306 — deadlines on the wall clock: an NTP step during
+a long spawn makes every in-flight deadline jump, classifying healthy
+workers as timed out."""
+import time
+
+
+def wait_ready(proc, timeout):
+    deadline = time.time() + timeout  # DLG306: deadline on the wall clock
+    while proc.poll() is None:
+        time.sleep(0.01)
+    return deadline
+
+
+def elapsed_ms(t0):
+    return (time.time() - t0) * 1000.0  # DLG306: interval on the wall clock
+
+
+class Monitor:
+    def busy_for(self):
+        t0 = time.time()
+        self.work()
+        return time.time() - t0  # DLG306: duration on the wall clock
+
+    def work(self):
+        pass

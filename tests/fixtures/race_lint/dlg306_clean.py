@@ -1,0 +1,29 @@
+"""Clean fixture: DLG306 — monotonic clocks for intervals; the wall clock
+survives only as a timestamp (no arithmetic)."""
+import time
+
+
+def wait_ready(proc, timeout):
+    deadline = time.perf_counter() + timeout
+    while proc.poll() is None:
+        if time.perf_counter() > deadline:
+            raise TimeoutError
+        time.sleep(0.01)
+
+
+def elapsed_ms(t0):
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def wall_stamp():
+    return time.time()  # a timestamp, not an interval — fine
+
+
+class Monitor:
+    def busy_for(self):
+        t0 = time.perf_counter()
+        self.work()
+        return time.perf_counter() - t0
+
+    def work(self):
+        pass

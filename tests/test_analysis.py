@@ -395,6 +395,20 @@ def test_fingerprint_detects_signature_drift():
     assert strong != weak
 
 
+def test_stale_pinned_fingerprint_reported_as_dlg108():
+    """A baseline fingerprint for an entry point that no longer exists is
+    baseline staleness (DLG108), distinct from live drift (DLG204) — a
+    dead pin would otherwise shadow a future entry of the same name."""
+    from distributed_llama_tpu.analysis.jaxpr_audit import audit_all
+
+    findings, fingerprints = audit_all({"no_such_entry": "deadbeef"})
+    stale = [f for f in findings if f.rule == "DLG108"]
+    assert any(f.file == "<entry:no_such_entry>" for f in stale)
+    assert all(f.rule != "DLG204" or "no_such_entry" not in f.file
+               for f in findings)
+    assert "no_such_entry" not in fingerprints
+
+
 # -- the real gate: current tree vs committed baseline ----------------------
 
 
@@ -404,15 +418,15 @@ def test_analyzer_gate_repo_is_clean():
     baseline. A new host sync / f64 promotion / debug leftover anywhere in
     the package fails this test with the finding list in the message."""
     from distributed_llama_tpu.analysis.__main__ import (DEFAULT_BASELINE,
-                                                         PKG_DIR)
-    from distributed_llama_tpu.analysis.ast_lint import lint_package
-    from distributed_llama_tpu.analysis.jaxpr_audit import audit_all
+                                                         gather_findings,
+                                                         hygiene_findings)
 
-    findings = lint_package(PKG_DIR, prefix="distributed_llama_tpu/")
     baseline = load_baseline(DEFAULT_BASELINE)
-    jaxpr_findings, fingerprints = audit_all(baseline.get("fingerprints", {}))
-    findings.extend(jaxpr_findings)
+    # same collection the CLI gate runs (L1 + dlrace + DLG206 + jaxpr),
+    # so this test and `--check` cannot drift
+    findings, fingerprints = gather_findings(baseline)
     new, _ = split_by_baseline(findings, baseline)
+    new.extend(hygiene_findings(findings, baseline))
     assert not new, "\n".join(f"{f.anchor()}: {f.rule} {f.message}"
                               for f in new)
     # every audited entry point must have a pinned fingerprint — a NEW

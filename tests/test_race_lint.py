@@ -1,0 +1,345 @@
+"""dlrace (DLG3xx) lock-discipline lint tests.
+
+Four kinds of coverage, all non-slow so `pytest -m "not slow"` enforces
+the race gate exactly like CI:
+
+* fixture corpus: one tripping + one clean file per rule under
+  tests/fixtures/race_lint/, the tripping ones reconstructing the four
+  historical host-side races (probe leak, deque-mutated-during-iteration,
+  close/submit TOCTOU, unjoined `_rebuild` thread);
+* convention tests: `_locked` suffix, `# dlrace: holds(...)`, inline
+  `# dlrace: ignore[...]` suppression, scope membership;
+* baseline hygiene: DLG108 stale-entry and DLG109 missing-justification
+  detection, plus the live baseline's full justification coverage and the
+  no-bare-suppression policy over the race scope;
+* the JAX-free repo gate (L1 + dlrace + DLG206 against the committed
+  baseline) and regression tests for live findings this lint got fixed.
+"""
+
+import pathlib
+import threading
+import time
+
+from distributed_llama_tpu.analysis.findings import (load_baseline,
+                                                     split_by_baseline,
+                                                     unjustified_keys)
+from distributed_llama_tpu.analysis.race_lint import (RACE_SCOPE,
+                                                      in_race_scope,
+                                                      race_lint_source)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "race_lint"
+
+
+def lint_fixture(name):
+    return race_lint_source(f"tests/fixtures/race_lint/{name}",
+                            (FIXTURES / name).read_text())
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- fixture corpus: tripping + clean per rule ------------------------------
+
+
+def test_dlg301_close_submit_toctou_trips():
+    """Historical bug #3: close() flips the flag and drains lock-free
+    while submit() appends after its lock-free check."""
+    fs = lint_fixture("dlg301_bad.py")
+    assert rules_of(fs) == ["DLG301"] * 3
+    msgs = " ".join(f.message for f in fs)
+    assert "Scheduler.submit" in msgs and "Scheduler.close" in msgs
+    assert "`self._queue.append()`" in msgs
+    assert "write to `self._closed`" in msgs
+
+
+def test_dlg301_clean_lock_disciplined_scheduler():
+    assert lint_fixture("dlg301_clean.py") == []
+
+
+def test_dlg302_blocking_sleep_under_guard_trips():
+    fs = lint_fixture("dlg302_bad.py")
+    assert rules_of(fs) == ["DLG302"]
+    assert "time.sleep" in fs[0].message and "_lock" in fs[0].message
+
+
+def test_dlg302_clean_slow_work_outside_and_io_mutex_exempt():
+    """The claim-then-work shape passes, and the dedicated send mutex
+    (un-annotated by design) never counts as a held guard."""
+    assert lint_fixture("dlg302_clean.py") == []
+
+
+def test_dlg303_probe_leak_trips():
+    """Historical bug #1: bare acquire stranded by a raising probe."""
+    fs = lint_fixture("dlg303_bad.py")
+    assert rules_of(fs) == ["DLG303"]
+    assert "`_lock.acquire()`" in fs[0].message
+    assert "try/finally" in fs[0].message
+
+
+def test_dlg303_clean_try_finally_and_with():
+    assert lint_fixture("dlg303_clean.py") == []
+
+
+def test_dlg304_unjoined_rebuild_thread_trips():
+    """Historical bug #4: close() joins the watchdog, forgets the
+    in-flight rebuild thread."""
+    fs = lint_fixture("dlg304_bad.py")
+    assert rules_of(fs) == ["DLG304"]
+    assert "`self._rebuild_thread`" in fs[0].message
+    assert "close/shutdown" in fs[0].message
+
+
+def test_dlg304_clean_snapshot_join_and_local_thread():
+    assert lint_fixture("dlg304_clean.py") == []
+
+
+def test_dlg305_deque_mutated_during_iteration_trips():
+    """Historical bug #2: the stats scan iterating the live window while
+    the step loop appends — all three iteration shapes fire."""
+    fs = lint_fixture("dlg305_bad.py")
+    assert rules_of(fs) == ["DLG305"] * 3
+    fields = " ".join(f.message for f in fs)
+    assert "`self._window`" in fields and "`self._by_key`" in fields
+
+
+def test_dlg305_clean_snapshot_under_lock():
+    assert lint_fixture("dlg305_clean.py") == []
+
+
+def test_dlg306_wall_clock_intervals_trip():
+    fs = lint_fixture("dlg306_bad.py")
+    assert rules_of(fs) == ["DLG306"] * 3
+    assert all("time.time()" in f.message for f in fs)
+
+
+def test_dlg306_clean_monotonic_and_bare_timestamp():
+    assert lint_fixture("dlg306_clean.py") == []
+
+
+# -- conventions: holds(), _locked, suppression, scope ----------------------
+
+
+def test_holds_annotation_and_locked_suffix_satisfy_the_guard():
+    src = (
+        "import threading\n"
+        "from collections import deque\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._q = deque()  # dlrace: guarded-by(self._mu)\n"
+        "    def _pump_locked(self):\n"
+        "        self._q.append(1)\n"
+        "    def _drain(self):  # dlrace: holds(self._mu)\n"
+        "        self._q.popleft()\n"
+        "    def broken(self):\n"
+        "        self._q.append(2)\n"
+    )
+    fs = race_lint_source("x.py", src)
+    assert rules_of(fs) == ["DLG301"]
+    assert "S.broken" in fs[0].message
+
+
+def test_dlrace_inline_suppression():
+    src = (
+        "import threading\n"
+        "from collections import deque\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._q = deque()  # dlrace: guarded-by(self._mu)\n"
+        "    def hot(self):\n"
+        "        self._q.append(1)  # dlrace: ignore[DLG301]\n"
+    )
+    assert race_lint_source("x.py", src) == []
+    # the suppression is rule-scoped: a different rule still fires
+    narrowed = src.replace("ignore[DLG301]", "ignore[DLG305]")
+    assert rules_of(race_lint_source("x.py", narrowed)) == ["DLG301"]
+
+
+def test_nested_def_does_not_inherit_held_locks():
+    src = (
+        "import threading\n"
+        "from collections import deque\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._q = deque()  # dlrace: guarded-by(self._mu)\n"
+        "    def arm(self):\n"
+        "        with self._mu:\n"
+        "            def cb():\n"
+        "                self._q.append(1)\n"
+        "            return cb\n"
+    )
+    # cb runs later, on whatever thread fires it — the with-block's held
+    # set must not leak into it
+    assert rules_of(race_lint_source("x.py", src)) == ["DLG301"]
+
+
+def test_race_scope_membership():
+    assert in_race_scope("distributed_llama_tpu/runtime/scheduler.py")
+    assert in_race_scope("distributed_llama_tpu/apps/api_server.py")
+    assert in_race_scope("distributed_llama_tpu/parallel/multihost.py")
+    assert not in_race_scope("distributed_llama_tpu/parallel/collectives.py")
+    assert not in_race_scope("distributed_llama_tpu/model/llama.py")
+    assert sorted(RACE_SCOPE) == ["apps/", "parallel/multihost.py",
+                                  "runtime/"]
+
+
+# -- baseline hygiene: DLG108 / DLG109 --------------------------------------
+
+
+def test_dlg108_stale_allowlist_entry_reported():
+    from distributed_llama_tpu.analysis.__main__ import hygiene_findings
+
+    baseline = {"findings": ["DLG301|gone.py|msg"],
+                "justifications": {"DLG301|gone.py|msg": "why"}}
+    out = hygiene_findings([], baseline)
+    assert rules_of(out) == ["DLG108"]
+    assert "stale baseline" in out[0].message
+    assert "DLG301|gone.py|msg" in out[0].message
+
+
+def test_dlg109_unjustified_entry_reported():
+    from distributed_llama_tpu.analysis.__main__ import hygiene_findings
+
+    baseline = {"findings": ["DLG301|a.py|m"],
+                "justifications": {"DLG301|a.py|m":
+                                   "TODO: justify this entry"}}
+    bad = hygiene_findings([], baseline)
+    assert set(rules_of(bad)) == {"DLG108", "DLG109"}
+    assert unjustified_keys(baseline) == ["DLG301|a.py|m"]
+
+
+def test_live_baseline_every_entry_justified():
+    """The acceptance bar: zero baseline entries without a one-line
+    justification — an allowlisted race is a reviewed decision."""
+    from distributed_llama_tpu.analysis.__main__ import DEFAULT_BASELINE
+
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert baseline["findings"], "baseline unexpectedly empty"
+    assert unjustified_keys(baseline) == []
+
+
+def test_no_bare_dlrace_suppressions_in_race_scope():
+    """Policy: a suppression without a rule list silences EVERYTHING on
+    the line — banned in the race scope (baseline with a justification
+    instead)."""
+    import re
+
+    from distributed_llama_tpu.analysis.__main__ import PKG_DIR
+    from distributed_llama_tpu.analysis.ast_lint import iter_package_files
+
+    bare = re.compile(r"#\s*dl(?:grind|race):\s*ignore(?!\[)")
+    offenders = []
+    for rel in iter_package_files(PKG_DIR):
+        if not in_race_scope(rel):
+            continue
+        src = (pathlib.Path(PKG_DIR) / rel).read_text()
+        for i, line in enumerate(src.splitlines(), start=1):
+            if bare.search(line):
+                offenders.append(f"{rel}:{i}")
+    assert not offenders, offenders
+
+
+# -- the JAX-free repo gate + DLG206 ----------------------------------------
+
+
+def test_race_gate_repo_is_clean_without_jax():
+    """CI's lint-analysis job, pytest-collected: L1 + dlrace + the
+    serving-path D2H audit against the committed baseline, no JAX import
+    required (the jaxpr level has its own gate in test_analysis)."""
+    from distributed_llama_tpu.analysis.__main__ import (DEFAULT_BASELINE,
+                                                         gather_findings,
+                                                         hygiene_findings)
+
+    baseline = load_baseline(DEFAULT_BASELINE)
+    findings, _ = gather_findings(baseline, no_jaxpr=True)
+    new, _ = split_by_baseline(findings, baseline)
+    new.extend(hygiene_findings(findings, baseline))
+    assert not new, "\n".join(f"{f.anchor()}: {f.rule} {f.message}"
+                              for f in new)
+
+
+def test_dlg206_pins_the_host_sampling_transfers():
+    """The per-token serving path reaches the four known host-sampling
+    D2H sites (draft sampling + engine sampling/lookup) — and every one
+    is a baselined, justified decision, not a silent cost."""
+    from distributed_llama_tpu.analysis.__main__ import (DEFAULT_BASELINE,
+                                                         PKG_DIR)
+    from distributed_llama_tpu.analysis.serving_d2h import audit_serving_path
+
+    fs = audit_serving_path(PKG_DIR, prefix="distributed_llama_tpu/")
+    assert fs and all(f.rule == "DLG206" for f in fs)
+    files = {f.file.rsplit("/", 1)[-1] for f in fs}
+    assert {"draft.py", "engine.py"} <= files
+    baseline = load_baseline(DEFAULT_BASELINE)
+    keys = set(baseline["findings"])
+    just = baseline.get("justifications", {})
+    for f in fs:
+        assert f.key() in keys, f"unbaselined serving-path D2H: {f.key()}"
+        assert just.get(f.key()), f"no justification for {f.key()}"
+
+
+# -- regression tests for live findings this lint got fixed -----------------
+
+
+def test_remote_handle_close_joins_monitor_thread():
+    """DLG304 live fix (router.py): RemoteReplicaHandle.close() must wait
+    for the monitor thread instead of letting interpreter teardown race
+    its health probes into a closed client."""
+    from distributed_llama_tpu.runtime.router import RemoteReplicaHandle
+
+    h = RemoteReplicaHandle.__new__(RemoteReplicaHandle)
+    h._closed = False
+    h.draining = False
+    h._proc = None
+    h._poll = 0.05
+
+    class _Client:
+        def close(self):
+            pass
+
+    h.client = _Client()
+    gate = threading.Event()
+    exited = threading.Event()
+
+    def monitor():
+        # parked mid-poll when close() runs — without the join, close()
+        # returns while this thread is still alive
+        gate.wait(timeout=0.3)
+        assert h._closed
+        exited.set()
+
+    h._monitor_thread = threading.Thread(target=monitor, daemon=True)
+    h._monitor_thread.start()
+    t0 = time.perf_counter()
+    h.close(timeout=5.0)
+    assert exited.is_set(), "close() returned before the monitor exited"
+    assert not h._monitor_thread.is_alive()
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_kv_transfer_summary_consistent_under_concurrent_appends():
+    """DLG305 baselined decision (stats.py KVTransferStats.summary):
+    list(deque) snapshots atomically under the GIL — hammer appends while
+    summarizing and require no RuntimeError and sane aggregates."""
+    from distributed_llama_tpu.runtime.stats import KVTransferStats
+
+    st = KVTransferStats(enabled=True, tier="mixed")
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            st.note_transfer_ms(1.0)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            s = st.summary()
+            assert isinstance(s, dict)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert not t.is_alive()

@@ -81,14 +81,12 @@ WORKER_ENV = {
 }
 
 SPAWN_TIMEOUT = 120.0   # worker startup bound (import + build + warmup)
-# death -> routable-again acceptance HARD CEILING. Deliberately
-# load-tolerant (ISSUE 10 deflake): a respawn is a full interpreter +
-# jax import + engine build + warmup in a fresh subprocess — ~4 s alone
-# with a warm XLA cache, but IN-SUITE the whole pytest run competes for
-# the same cores and the bound was observed flaking at 60 s while the
-# standalone run passed. The assertions below poll (_wait) and only
-# fail at this ceiling; the zero-unstreamed-failures / parity /
-# classification bars stay EXACT — only the timing bound is widened.
+# _wait's give-up ceiling. Nothing below asserts elapsed time against
+# it: the chaos tests wait on OBSERVABLE monitor transitions (exit
+# classified -> respawn counted -> routable) and this bound only
+# decides when a wait that will never succeed stops burning CI time.
+# A respawn is a full interpreter + jax import + engine build + warmup
+# in a fresh subprocess, so the ceiling is generous by construction.
 RESPAWN_BOUND = 180.0
 
 
@@ -291,7 +289,6 @@ def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
         req_c = router.submit(p, 6, _greedy())
         assert (req_a.replica_id, req_b.replica_id,
                 req_c.replica_id) == (0, 1, 0)
-        t_kill = time.perf_counter()
         os.kill(h0._proc.proc.pid, signal.SIGKILL)
 
         # A: already streamed -> structured NON-retryable frame
@@ -311,15 +308,17 @@ def test_sigkill_mid_stream_zero_unstreamed_failures_and_respawn(
         # B (on the surviving replica) never noticed
         assert list(req_b.tokens(timeout=120.0)) == want6
 
-        # supervised respawn: classified, counted, routable within the
-        # (load-tolerant) ceiling — poll-until with a hard bound, both
-        # measured from the kill itself
-        assert _wait(lambda: h0.ready,
-                     max(RESPAWN_BOUND
-                         - (time.perf_counter() - t_kill), 1.0)), \
-            f"r0 not routable {RESPAWN_BOUND}s after SIGKILL"
-        t_routable = time.perf_counter() - t_kill
-        assert t_routable < RESPAWN_BOUND
+        # supervised respawn, event-driven: wait on each observable state
+        # transition of the monitor in order — exit CLASSIFIED, respawn
+        # COUNTED, worker routable. RESPAWN_BOUND is only _wait's
+        # give-up ceiling; no assertion does wall-clock arithmetic.
+        assert _wait(lambda: h0.proc_stats.exit_classes
+                     .get("signal:SIGKILL", 0) >= 1), \
+            "monitor never classified the SIGKILL"
+        assert _wait(lambda: h0.proc_stats.respawns >= 1), \
+            "monitor never completed a respawn"
+        assert _wait(lambda: h0.ready), \
+            "respawned worker never became routable"
         ps = h0.proc_stats.summary()
         assert ps["exit_classes"].get("signal:SIGKILL") == 1
         assert ps["respawns"] == 1
