@@ -57,7 +57,13 @@ _SLO_ITL_MS/_IAT/_LONG size it), BENCH_KVX=1 to add the cross-replica KV
 block transfer row (_kvx_row: cold-replica fills OFF vs ON on a
 shared-prefix trace — TTFT p50, fill hit rate, wire bytes reconciled —
 plus the disaggregated prefill/decode A/B;
-BENCH_KVX_FAMILIES/_SYS/_BLOCK/_TOKENS/_IAT/_LONG/_STREAMS size it), and
+BENCH_KVX_FAMILIES/_SYS/_BLOCK/_TOKENS/_IAT/_LONG/_STREAMS size it),
+BENCH_FLEET=1 to add the fleet-brain chaos row (_fleet_row: two tenants
+through a 10x Poisson spike + one worker SIGKILL under the autoscaling
+FleetController — victim p99 TTFT at SLO, replicas visibly scaling,
+zero unstreamed failures;
+BENCH_FLEET_REQUESTS/_VICTIM/_TOKENS/_STEP_MS/_SLO_MS/_IAT/
+_SPAWN_TIMEOUT size it), and
 BENCH_VOCAB=1 to add the
 vocab-sharding A/B row (_vocab_row: sharded vs replicated embedding+head
 on one mixed greedy/sampled trace over a tp mesh — greedy parity
@@ -1857,6 +1863,227 @@ def _router_procs_row(prefix: str) -> dict:
     }
 
 
+def _fleet_row(prefix: str) -> dict:
+    """Fleet-brain chaos row (the ISSUE-18 metric): TWO tenants drive a
+    process-replica tier through a 10x Poisson load spike with one
+    replica SIGKILLed mid-spike, under the FleetController
+    (runtime/fleet.py). The victim tenant (high priority, weight 4)
+    sends the SAME slow trickle before and during the spike; the hog
+    tenant (low priority, weight 1, token-budgeted) floods 10x arrivals
+    only during the spike. Reported bars:
+
+      * victim_p99_ttft_ms — the victim's spike-phase p99 TTFT must
+        stay at SLO (BENCH_FLEET_SLO_MS, default 2000): weighted-fair
+        queueing means the hog's overage buys the hog latency, not the
+        victim;
+      * victim_p99_ratio — spike p99 over baseline p99 (reported; the
+        fairness story in one number);
+      * scale_ups >= 1 — the controller VISIBLY grew the replica set
+        under the spike (pressure EWMA over threshold), HBM-capped;
+      * unstreamed_failures == 0 — the SIGKILL mid-spike failed over
+        every not-yet-streamed request; nothing was silently lost.
+
+    Env knobs: BENCH_FLEET_REQUESTS (hog spike requests, default 16),
+    BENCH_FLEET_VICTIM (victim requests per phase, default 6),
+    BENCH_FLEET_TOKENS (decode budget, default 6), BENCH_FLEET_STEP_MS
+    (worker decode pacing, default 40), BENCH_FLEET_SLO_MS (victim p99
+    TTFT bar, default 2000), BENCH_FLEET_IAT (victim inter-arrival s,
+    default 0.5; the hog floods at IAT/10), BENCH_FLEET_SPAWN_TIMEOUT
+    (startup/scale-up bound, default 300 s)."""
+    import gc
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+
+    from distributed_llama_tpu.runtime.fleet import (FleetConfig,
+                                                     FleetController)
+    from distributed_llama_tpu.runtime.replica_worker import WorkerProc
+    from distributed_llama_tpu.runtime.router import (RemoteReplicaHandle,
+                                                      Router)
+    from distributed_llama_tpu.runtime.scheduler import RequestError
+    from distributed_llama_tpu.sampler import Sampler
+
+    n_hog = max(int(os.environ.get("BENCH_FLEET_REQUESTS", "16")), 4)
+    n_victim = max(int(os.environ.get("BENCH_FLEET_VICTIM", "6")), 3)
+    budget = int(os.environ.get("BENCH_FLEET_TOKENS", "6"))
+    step_ms = int(os.environ.get("BENCH_FLEET_STEP_MS", "40"))
+    slo_ms = float(os.environ.get("BENCH_FLEET_SLO_MS", "2000"))
+    iat = float(os.environ.get("BENCH_FLEET_IAT", "0.5"))
+    spawn_timeout = float(os.environ.get("BENCH_FLEET_SPAWN_TIMEOUT",
+                                         "300"))
+
+    spec_fields = dict(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=128)
+    cfg = {"test_spec": spec_fields, "seed": 11, "scale": 0.05,
+           "compute_dtype": "f32", "batch": 2,
+           # the whole spike may queue on two replicas while the third
+           # spawns; weighted-fair ordering happens IN this queue
+           "serve": {"stall_timeout": 60.0,
+                     "max_queue": n_hog + 2 * n_victim,
+                     # hog sustains 50 tok/s; the victim is unlimited —
+                     # over budget, the hog is served only when no
+                     # in-budget tenant waits
+                     "tenant_budgets": "hog=1:50,victim=4"},
+           "trace": {"capacity": 2048, "decode_every": 1 << 30}}
+    wenv = {"JAX_PLATFORMS": "cpu",
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                os.path.expanduser("~"), ".cache", "dllama_tpu_xla"),
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1.0"}
+    workdir = tempfile.mkdtemp(prefix="dllama-bench-fleet-")
+
+    def mk(i):
+        proc = WorkerProc(i, dict(cfg, fault_key=f"r{i}"), workdir=workdir,
+                          env=wenv,
+                          faults=f"slow_step:times=0;ms={step_ms}")
+        return RemoteReplicaHandle(i, proc=proc, poll_interval=0.1,
+                                   spawn_backoff_base=0.05,
+                                   spawn_timeout=spawn_timeout,
+                                   respawn_timeout=spawn_timeout)
+
+    handles: list = [None, None]
+    builders = [threading.Thread(target=lambda i=i: handles.__setitem__(
+        i, mk(i))) for i in (0, 1)]
+    for t in builders:
+        t.start()
+    for t in builders:
+        t.join()
+    if any(h is None for h in handles):
+        for h in handles:
+            if h is not None:
+                h.close()
+        raise RuntimeError("replica worker spawn failed (see workdir logs)")
+
+    router = Router(None, policy="round_robin", retry_budget=1,
+                    handle_factories=[lambda: handles[0],
+                                      lambda: handles[1]])
+    # arm the scale-up path: the controller spawns r2.. through this
+    router._spawn_factory = lambda rid, tier: mk(rid)
+    fleet = FleetController(
+        router, config=FleetConfig(min_replicas=2, max_replicas=3,
+                                   poll=0.1, up_pressure=0.6,
+                                   up_after=2, down_after=10_000,
+                                   cooldown_ticks=2))
+    h0 = router.replicas[0]
+    rng = np.random.default_rng(7)
+    prompt_of: dict = {}
+    ttfts: dict = {}    # label -> ms
+    errs: dict = {}
+
+    def greedy():
+        return Sampler(spec_fields["vocab_size"], temperature=0.0,
+                       topp=0.9, seed=5)
+
+    def client(label, tenant, priority, prompt):
+        got: list = []
+        t0 = _time.perf_counter()
+        try:
+            req = router.submit(prompt, budget, greedy(),
+                                tenant=tenant, priority=priority)
+            for t in req.tokens(timeout=300.0):
+                if not got:
+                    ttfts[label] = (_time.perf_counter() - t0) * 1e3
+                got.append(t)
+            prompt_of[label] = (tuple(prompt), tuple(got))
+        except (RequestError, Exception) as e:  # noqa: BLE001
+            errs[label] = (len(got), e)
+
+    def run_phase(phase, victim_iat, hog_n, hog_iat, kill_at=None):
+        threads = []
+        v_arr = np.cumsum(rng.exponential(victim_iat, n_victim))
+        h_arr = (np.cumsum(rng.exponential(hog_iat, hog_n))
+                 if hog_n else np.array([]))
+        events = sorted(
+            [(t, "victim", i) for i, t in enumerate(v_arr)]
+            + [(t, "hog", i) for i, t in enumerate(h_arr)])
+        t0 = _time.perf_counter()
+        for k, (at, who, i) in enumerate(events):
+            dt = t0 + at - _time.perf_counter()
+            if dt > 0:
+                _time.sleep(dt)
+            n_tok = 12 + 4 * (i % 3)
+            prompt = rng.integers(1, spec_fields["vocab_size"],
+                                  n_tok).astype(np.int64).tolist()
+            pr = "high" if who == "victim" else "low"
+            th = threading.Thread(target=client,
+                                  args=(f"{phase}:{who}:{i}", who, pr,
+                                        prompt), daemon=True)
+            th.start()
+            threads.append(th)
+            if kill_at is not None and k + 1 == kill_at:
+                os.kill(h0._proc.proc.pid, _signal.SIGKILL)
+        for th in threads:
+            th.join(timeout=300.0)
+
+    try:
+        # baseline: the victim alone, controller running but unprovoked
+        fleet.start()
+        run_phase("base", iat, 0, 0.0)
+        base = sorted(v for k, v in ttfts.items() if k.startswith("base:"))
+        # spike: hog floods at 10x the victim's rate; SIGKILL replica 0
+        # a third of the way in — the controller must absorb BOTH
+        run_phase("spike", iat, n_hog, iat / 10.0,
+                  kill_at=max((n_hog + n_victim) // 3, 2))
+        # let in-flight scale decisions land before reading the summary
+        deadline = _time.perf_counter() + spawn_timeout
+        while (_time.perf_counter() < deadline
+               and router.scaling is not None):
+            _time.sleep(0.05)
+    finally:
+        fleet_summary = fleet.summary()
+        fleet.close()
+        stats = router.stats
+        router.close()
+        gc.collect()
+
+    spike = sorted(v for k, v in ttfts.items() if k.startswith("spike:")
+                   and ":victim:" in k)
+    base_p99 = base[int(0.99 * (len(base) - 1))] if base else None
+    victim_p99 = spike[int(0.99 * (len(spike) - 1))] if spike else None
+    # per-tenant view from the CLIENT side (the WFQ ledger itself lives
+    # in the workers, where the queueing happens): completions + spike
+    # p99 per tenant — the hog's queueing delay vs the victim's
+    tenant_view = {}
+    for who in ("victim", "hog"):
+        lat = sorted(v for k, v in ttfts.items()
+                     if k.startswith("spike:") and f":{who}:" in k)
+        tenant_view[who] = {
+            "completed": sum(1 for k in ttfts if f":{who}:" in k),
+            "spike_p99_ttft_ms": (round(lat[int(0.99 * (len(lat) - 1))], 1)
+                                  if lat else None),
+        }
+    # greedy parity across every completion of the same prompt length
+    # is not meaningful here (prompts are unique); the parity bar lives
+    # in the router/procs rows — this row pins fairness + scaling
+    unstreamed = sum(1 for n, _ in errs.values() if n == 0)
+    return {
+        "metric": f"{prefix}_fleet_spike_victim_p99_ttft_ms",
+        "value": (None if victim_p99 is None else round(victim_p99, 1)),
+        "unit": "ms", "vs_baseline": None,
+        "mode": "process", "boot_replicas": 2,
+        "hog_requests": n_hog, "victim_requests_per_phase": n_victim,
+        "decode_step_ms": step_ms, "slo_ms": slo_ms,
+        "victim_base_p99_ttft_ms": (None if base_p99 is None
+                                    else round(base_p99, 1)),
+        "victim_p99_ratio": (None if not (base_p99 and victim_p99)
+                             else round(victim_p99 / base_p99, 2)),
+        "victim_within_slo": (victim_p99 is not None
+                              and victim_p99 <= slo_ms),
+        "scale_ups": fleet_summary.get("scale_ups", 0),
+        "scale_blocked_hbm": fleet_summary.get("scale_blocked_hbm", 0),
+        "actual_replicas_end": fleet_summary.get("actual_replicas"),
+        "tenants": tenant_view,
+        "completed": len(ttfts),
+        "unstreamed_failures": unstreamed,
+        "midstream_failures": sum(1 for n, _ in errs.values() if n > 0),
+        "retries": stats.retries, "failovers_ok": stats.failovers_ok,
+        # the acceptance bars ride the row
+        "within_bound": (victim_p99 is not None and victim_p99 <= slo_ms
+                         and unstreamed == 0
+                         and fleet_summary.get("scale_ups", 0) >= 1),
+    }
+
+
 def _cluster_chaos_row(prefix: str) -> dict:
     """Cluster worker-loss detection latency (the ISSUE-5 metric): spawn
     REAL two-OS-process control-plane clusters (parallel/cluster_harness
@@ -2615,6 +2842,13 @@ def main() -> None:
                 # respawn-to-routable latency, availability %, zero
                 # unstreamed failures, token parity
                 emit(_router_procs_row(prefix=metric.split("_decode")[0]))
+
+        if os.environ.get("BENCH_FLEET", "0") != "0":
+            # fleet-brain chaos row (runtime/fleet.py, ISSUE-18): two
+            # tenants through a 10x Poisson spike + one SIGKILL under
+            # the autoscaling controller — victim p99 TTFT at SLO,
+            # replicas visibly scaling, zero unstreamed failures
+            emit(_fleet_row(prefix=metric.split("_decode")[0]))
 
         if os.environ.get("BENCH_KVX", "0") != "0":
             # cross-replica KV block transfer row (runtime/

@@ -42,6 +42,7 @@ from http.server import (BaseHTTPRequestHandler, HTTPServer,
 import jax
 import numpy as np
 
+from ..runtime.fleet import ShedReject
 from ..runtime.resilience import EngineUnready
 from ..runtime.scheduler import PromptTooLong, QueueFull, RequestError
 
@@ -117,7 +118,9 @@ class ApiState:
                  slo_itl_ms: float | None = None,
                  autosize: dict | None = None,
                  draft: str | None = None, draft_len: int = 0,
-                 kv_transfer: bool = False, tiers=None):
+                 kv_transfer: bool = False, tiers=None,
+                 min_replicas: int = 0, max_replicas: int = 0,
+                 tenant_budgets: str | None = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler
@@ -218,6 +221,17 @@ class ApiState:
         self.slo_ttft_ms = slo_ttft_ms
         self.slo_itl_ms = slo_itl_ms
         self.autosize = autosize
+        # the fleet brain (runtime/fleet.py): --min/--max-replicas arm
+        # load-adaptive autoscaling of the replica set, --tenant-budgets
+        # arms weighted-fair queueing + per-tenant token buckets, and
+        # either SLO target arms the overload shed ladder. The
+        # controller is built WITH the front door (scheduler()) so the
+        # fleet /stats + /metrics block exists in every scheduler tier.
+        self.min_replicas = int(min_replicas or 0)
+        self.max_replicas = int(max_replicas or 0)
+        self.tenant_budgets = tenant_budgets
+        self._fleet = None
+        self.tenant_ledger = None
 
     def build_info(self) -> dict:
         """{version, jax, backend, mesh} — computed once (the backend
@@ -245,8 +259,14 @@ class ApiState:
         and tp×replicas combination at startup."""
         with self.engine_lock:  # two first requests must not double-build
             if self._scheduler is None:
+                from ..runtime.fleet import (FleetConfig, FleetController,
+                                             ShedLadder, TenantLedger,
+                                             parse_tenant_budgets)
                 from ..runtime.router import build_front_door
 
+                if self.tenant_budgets and self.tenant_ledger is None:
+                    self.tenant_ledger = TenantLedger(
+                        parse_tenant_budgets(self.tenant_budgets))
                 self._scheduler = build_front_door(
                     self.engine, serve_batch=self.serve_batch,
                     serve_chunk=self.serve_chunk,
@@ -266,8 +286,32 @@ class ApiState:
                     slo_itl_ms=self.slo_itl_ms,
                     draft=self.draft, draft_len=self.draft_len,
                     draft_vocab=self.tokenizer.vocab_size,
-                    kv_transfer=self.kv_transfer, tiers=self.tiers)
+                    kv_transfer=self.kv_transfer, tiers=self.tiers,
+                    tenant_ledger=self.tenant_ledger)
+                # the fleet brain rides every scheduler tier: the shed
+                # ladder arms off the SLO targets (no SLO = no ladder,
+                # admit() passes through), autoscaling arms off the
+                # --min/--max-replicas window (FleetController scales
+                # only when the door exposes a spawn factory)
+                boot = max(self.replicas, self.replica_procs,
+                           len(self.replica_hosts or ()), 1)
+                cfg = FleetConfig(
+                    min_replicas=self.min_replicas or boot,
+                    max_replicas=self.max_replicas or boot)
+                ladder = (ShedLadder()
+                          if (self.slo_ttft_ms or self.slo_itl_ms)
+                          else None)
+                self._fleet = FleetController(
+                    self._scheduler, config=cfg, ladder=ladder,
+                    ledger=self.tenant_ledger)
+                self._fleet.start()
             return self._scheduler
+
+    def fleet(self):
+        """The fleet controller, built WITH the front door (None until
+        the first scheduler-path request forces the build)."""
+        self.scheduler()
+        return self._fleet
 
     def batch_engine(self):
         """The batched engine — the SCHEDULER's engine (one live batched
@@ -491,6 +535,25 @@ def _completion_chunks(state: ApiState, body: dict):
                     "completion_tokens": emitted})
 
 
+def _prefix_would_hit(door, tokens: list[int]) -> bool:
+    """Would this prompt seed from a radix prefix cache anywhere in the
+    tier? The ladder's prefix_only rung admits only work that reuses
+    cached KV (cheap prefill). Read-only peeks (match_len /
+    kv_match_len), never a pin; any failure reads as a miss — under
+    overload the conservative answer is to shed."""
+    try:
+        handles = getattr(door, "replicas", None)
+        if handles:
+            return any(h.match_len(tokens) > 0 for h in handles
+                       if not getattr(h, "reap", False))
+        sched = getattr(door, "_sched", None)
+        if sched is not None:
+            return sched.kv_match_len(tokens) > 0
+    except Exception:  # noqa: BLE001 — a mid-recovery replica peek
+        pass           # must never turn the shed door into a 500
+    return False
+
+
 def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
     """Scheduler-path generator for one /v1/completions or
     /v1/chat/completions request: enqueue onto the shared
@@ -531,6 +594,18 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
                       topp=state.sampler.topp, seed=seed)
     limit = engine.seq_len - len(tokens) - 1
     n_gen = min(max_tokens, limit) if max_tokens > 0 else limit
+    # the fleet brain's overload door (runtime/fleet.py): walk the shed
+    # ladder BEFORE submit — speculation off and max_tokens clamps are
+    # invisible degradation, prefix-only and shed raise ShedReject which
+    # the handler maps to a structured 429 (Retry-After from the live
+    # drain rate). Runs before any slot work, so a shed costs nothing.
+    tenant = body.get("tenant")
+    priority = str(body.get("priority") or "normal")
+    fleet = state.fleet()
+    if fleet is not None:
+        n_gen = fleet.admit(tenant=tenant, n_prompt=len(tokens),
+                            max_tokens=n_gen,
+                            prefix_hit=_prefix_would_hit(sched, tokens))
     # PromptTooLong raises HERE (before any event) — the handler still
     # turns it into a clean 400 through the queued/threaded path
     kwargs = {}
@@ -542,7 +617,7 @@ def _sched_completion_chunks(state: ApiState, body: dict, chat: bool = True):
         if session is not None:
             kwargs["session"] = str(session)
     req = sched.submit(tokens, n_gen, sampler, eos_id=tokenizer.eos_id,
-                       **kwargs)
+                       tenant=tenant, priority=priority, **kwargs)
 
     scan = _piece_scanner(tokenizer, tokens[-1], markers, stops)
     emitted = 0
@@ -946,6 +1021,14 @@ def make_handler(state: ApiState):
                     # carry the real aggregate on their summary)
                     from ..runtime.stats import KVTransferStats
                     payload["kv_transfer"] = KVTransferStats().summary()
+                # the fleet brain's block (runtime/fleet.py): autoscale
+                # decisions, ladder rung, per-tenant accounting — the
+                # same tier-invariance rule, so an idle/legacy tier
+                # answers enabled=False instead of losing the family
+                from ..runtime.stats import FleetStats
+                payload["fleet"] = (state._fleet.summary()
+                                    if state._fleet is not None
+                                    else FleetStats().summary())
                 from ..runtime.trace import TRACER
                 if TRACER.enabled:
                     payload["trace"] = TRACER.summary()
@@ -1020,6 +1103,13 @@ def make_handler(state: ApiState):
                 # legacy/idle scrape renders the family as enabled=False
                 from ..runtime.stats import KVTransferStats
                 payload["kv_transfer"] = KVTransferStats().summary()
+            if "fleet" not in payload:
+                # dllama_fleet_* in every tier incl. idle: enabled=False
+                # zeros until the controller exists (same rule again)
+                from ..runtime.stats import FleetStats
+                payload["fleet"] = (state._fleet.summary()
+                                    if state._fleet is not None
+                                    else FleetStats().summary())
             if ("hbm" not in payload and state.engine is not None
                     and not state.router_mode):
                 from ..runtime.profiler import hbm_ledger
@@ -1145,9 +1235,16 @@ def make_handler(state: ApiState):
                     # state can't see — a replica can be supervisor-ready
                     # yet unrouted (drained or circuit open), and the
                     # operator needs to see WHY from the probe body
+                    # a replica draining FOR REAP (fleet scale-down) is
+                    # expected capacity loss, not ill health: it shows
+                    # here as /reaping but never flips fleet readiness
+                    # (Router.state + _routable exclude reap handles)
                     payload["replicas"] = {
                         f"r{h.id}": (h.state
                                      + ("/draining" if h.draining else "")
+                                     + ("/reaping"
+                                        if getattr(h, "reap", False)
+                                        else "")
                                      + ("/breaker_open"
                                         if h.open_until > 0.0 else ""))
                         for h in sup.replicas}
@@ -1426,6 +1523,12 @@ def make_handler(state: ApiState):
                    f"{int(time.time() * 1000):x}")
             created = int(time.time())
             stream = bool(body.get("stream", False))
+            # multi-tenant identity (runtime/fleet.py): the body's
+            # `tenant` field wins, the X-Tenant header fills in — folded
+            # into the body HERE so the multi-host replay and the
+            # scheduler path read one source of truth
+            if "tenant" not in body and self.headers.get("X-Tenant"):
+                body["tenant"] = self.headers.get("X-Tenant")
 
             multihost = jax.process_count() > 1
             use_sched = state.serve_batch > 0 and not multihost
@@ -1460,6 +1563,13 @@ def make_handler(state: ApiState):
                     # admission control: overload is a FAST 429, not an
                     # unboundedly growing queue
                     self._json(429, {"error": str(e)},
+                               retry_after=e.retry_after)
+                    return
+                except ShedReject as e:
+                    # the fleet brain's overload ladder turned the
+                    # request away at the door: a structured 429 whose
+                    # Retry-After derives from the LIVE drain rate
+                    self._json(429, {"error": str(e), "shed": e.reason},
                                retry_after=e.retry_after)
                     return
                 except EngineUnready as e:
@@ -1744,6 +1854,50 @@ def serve(args) -> None:
             sys.exit("error: --tier needs at least one decode or mixed "
                      "replica (prefill-tier replicas never serve "
                      "requests)")
+    # fleet brain (runtime/fleet.py): same dead-flag discipline — an
+    # autoscaling window nothing can scale, or tenant budgets nothing
+    # enqueues fairly, must refuse at parse time, not silently no-op
+    min_reps = getattr(args, "min_replicas", 0) or 0
+    max_reps = getattr(args, "max_replicas", 0) or 0
+    if min_reps < 0 or max_reps < 0:
+        sys.exit("error: --min-replicas/--max-replicas must be >= 1")
+    if (min_reps or max_reps) and not serve_batch:
+        sys.exit("error: --min-replicas/--max-replicas require "
+                 "--serve-batch N (the fleet controller scales the "
+                 "replica set behind the scheduler front door)")
+    if min_reps and max_reps and min_reps > max_reps:
+        sys.exit(f"error: --min-replicas {min_reps} exceeds "
+                 f"--max-replicas {max_reps}")
+    if max_reps and replica_hosts_raw:
+        sys.exit("error: autoscaling does not reach --replica-hosts "
+                 "workers (their lifetimes are their operators'): the "
+                 "controller can only spawn/reap locally supervised "
+                 "replicas (--replicas/--replica-procs)")
+    if max_reps and max_reps > n_fleet and not (replicas > 1
+                                                or replica_procs):
+        sys.exit("error: --max-replicas needs a replica tier to grow "
+                 "(--replicas N or --replica-procs N)")
+    tenant_budgets_raw = getattr(args, "tenant_budgets", None)
+    if tenant_budgets_raw is not None:
+        if not serve_batch:
+            sys.exit("error: --tenant-budgets requires --serve-batch N "
+                     "(weighted-fair queueing replaces the scheduler's "
+                     "FIFO admission queue)")
+        if replica_hosts_raw:
+            # same contract as --draft/--slo-*: pre-started workers own
+            # their configs — fairness the parent cannot arm worker-side
+            # would silently degrade to FIFO where the queueing happens
+            sys.exit("error: --tenant-budgets does not reach "
+                     "--replica-hosts workers (their configs are their "
+                     "operators'): set tenant_budgets in each worker's "
+                     "own config instead")
+        from ..runtime.fleet import parse_tenant_budgets
+        try:
+            # parse NOW so a malformed spec refuses at startup, never
+            # mid-traffic in a worker process
+            parse_tenant_budgets(tenant_budgets_raw)
+        except ValueError as e:
+            sys.exit(f"error: --tenant-budgets: {e}")
     trace_on = bool(getattr(args, "trace", False))
     if not trace_on and (
             getattr(args, "trace_dir", None)
@@ -1899,7 +2053,10 @@ def serve(args) -> None:
                      worker_config=worker_config,
                      admin_token=getattr(args, "admin_token", None),
                      profile_dir=getattr(args, "profile_dir", None),
-                     kv_transfer=kv_transfer, tiers=tiers)
+                     kv_transfer=kv_transfer, tiers=tiers,
+                     min_replicas=getattr(args, "min_replicas", 0) or 0,
+                     max_replicas=getattr(args, "max_replicas", 0) or 0,
+                     tenant_budgets=getattr(args, "tenant_budgets", None))
     if session and os.path.exists(session):
         load_server_session(state, session)
         print(f"💾 resumed session from {session} "
@@ -1975,6 +2132,10 @@ def serve(args) -> None:
     finally:
         state.draining = True
         server.server_close()
+        if state._fleet is not None:
+            # stop the fleet brain BEFORE draining the door: a scale
+            # decision landing mid-shutdown would race the close below
+            state._fleet.close()
         if state._scheduler is not None:
             # finish in-flight/queued scheduler work before exiting; past
             # the deadline, close() fails stragglers with structured

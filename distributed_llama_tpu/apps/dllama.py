@@ -376,6 +376,37 @@ def build_argparser() -> argparse.ArgumentParser:
                         "--replica-hosts (set `tier` in each worker's "
                         "own config; the router learns it from the "
                         "health PONG)")
+    # fleet brain (runtime/fleet.py, docs/operations.md "Overload and
+    # autoscaling"): load-adaptive replica autoscaling, SLO-aware
+    # overload shedding, multi-tenant weighted fairness
+    p.add_argument("--min-replicas", type=int, default=0, metavar="N",
+                   help="api mode, with a replica tier: floor of the "
+                        "fleet controller's autoscaling window (default: "
+                        "the boot replica count — autoscaling off). The "
+                        "controller drains + reaps sustained-idle "
+                        "replicas down to this floor, folding their "
+                        "lifetime counters into the router totals")
+    p.add_argument("--max-replicas", type=int, default=0, metavar="N",
+                   help="api mode, with a replica tier: ceiling of the "
+                        "autoscaling window (default: the boot count — "
+                        "autoscaling off). Under sustained queue growth "
+                        "the controller spawns replicas up to N, hard-"
+                        "capped by the HBM ledger's slots_addable "
+                        "headroom; fresh replicas warm their caches "
+                        "from siblings via --kv-transfer fills before "
+                        "taking traffic")
+    p.add_argument("--tenant-budgets", default=None,
+                   metavar="NAME=W[:TPS],...",
+                   help="api mode, with --serve-batch: per-tenant "
+                        "weighted-fair queueing + token budgets. Each "
+                        "entry names a tenant with fair-share weight W "
+                        "and optional sustained tokens/sec budget (e.g. "
+                        "'gold=4:2000,free=1:100'). Tenants come from "
+                        "the request body `tenant` field or X-Tenant "
+                        "header (unknown tenants get weight 1, no "
+                        "budget); an over-budget tenant is served only "
+                        "when no in-budget tenant waits, so a hog's "
+                        "overage can never move a victim's p99")
     p.add_argument("--admin-token", default=None, metavar="TOKEN",
                    help="api mode: bearer token accepted on /admin/* as "
                         "an alternative to the loopback-only default "

@@ -58,6 +58,24 @@ donor worker's fault_key):
                          ``os._exit``s hard mid-stream (the SIGKILL/OOM
                          shape landing exactly between two block frames)
 
+Fleet-controller sites, fired in the autoscaler's decision loop
+(runtime/fleet.py) so anti-flap hysteresis and spawn backoff are
+count-deterministically testable (tests/test_fleet.py):
+
+  * ``spawn_stall``    — controller scale-up path, before the replica
+                         spawn: blocks like ``step_stall`` (a slow
+                         container/TPU grant — the controller must keep
+                         serving and keep its ``scaling_up`` state
+                         truthful while one spawn crawls; key-filtered
+                         by the new replica's ``rK`` so ONE scale-up
+                         stalls while siblings spawn clean)
+  * ``scale_flap``     — controller tick, ``triggered()`` form: each
+                         fire flips a synthetic full/empty load signal
+                         (the oscillating-traffic shape — the
+                         controller's EWMA + cooldown must NOT flap
+                         replicas up and down; the test counts fires,
+                         not wall time)
+
   * ``conn_refused``   — worker connect attempt: raises
                          ``ConnectionRefusedError`` (exercises the
                          cluster-formation retry/backoff path; ``times=K``
@@ -98,7 +116,7 @@ from .trace import TRACER
 SITES = ("step_raise", "step_stall", "prefill_raise", "slow_step",
          "replica_raise", "replica_stall", "worker_exit",
          "conn_refused", "recv_stall", "frame_truncate", "peer_close",
-         "kvx_stall", "kvx_exit")
+         "kvx_stall", "kvx_exit", "spawn_stall", "scale_flap")
 
 
 class FaultError(RuntimeError):
@@ -199,7 +217,7 @@ class FaultRegistry:
         if site.endswith("_raise"):
             raise FaultError(f"injected {site} (fire #{a.fired})")
         if site in ("step_stall", "recv_stall", "replica_stall",
-                    "kvx_stall"):
+                    "kvx_stall", "spawn_stall"):
             # block like the real hang: until released or ms elapses
             # (default: effectively forever — the watchdog's / the peer
             # heartbeat timeout's job)

@@ -80,7 +80,11 @@ from .trace import TRACER
 # transfer plane (runtime/kv_transfer.py) — RMSG_BLOCK_* verbs, and the
 # submit header grew fill_port/fill_expected (the router's fetch-from-
 # donor instruction) with the ACCEPT echoing the donor's answer.
-REPLICA_PROTOCOL_VERSION = 4
+# v5: multi-tenant fairness (runtime/fleet.py) — the submit header grew
+# priority (band index into fleet.PRIORITIES) + tenant_len, with the
+# tenant name riding the payload after the fill host, so the worker-side
+# WFQ orders the queue where the waiting actually happens.
+REPLICA_PROTOCOL_VERSION = 5
 
 # message kinds — a namespace distinct from the cluster control plane's
 # MSG_* so a replica socket accidentally pointed at a cluster control
@@ -117,12 +121,15 @@ RMSG_PROFILE = 119      # client -> worker (control): [ms] — write one
 #           below dispatches a QUERY-opening connection to BlockDonor
 
 # [max_tokens, temp_bits, topp_bits, rng_lo, rng_hi, vocab, deadline_ms,
-#  n_eos, trace_id, fill_port, fill_expected, fill_donor] then n_eos
-# stop ids then the prompt; the payload carries the fill donor's host
-# (utf-8, empty when fill_port == 0 — no fill requested). fill_donor is
-# the donor's replica id: the importer's wire ledger and kv_fill trace
-# events attribute per donor, not to a constant peer
-_SUBMIT_HEADER = 12
+#  n_eos, trace_id, fill_port, fill_expected, fill_donor, priority,
+#  tenant_len] then n_eos stop ids then the prompt; the payload carries
+# the fill donor's host (utf-8, empty when fill_port == 0 — no fill
+# requested) followed by tenant_len bytes of utf-8 tenant name
+# (tenant_len == 0 — untagged). fill_donor is the donor's replica id:
+# the importer's wire ledger and kv_fill trace events attribute per
+# donor, not to a constant peer. priority indexes fleet.PRIORITIES
+# (negative — untagged, the worker's default band).
+_SUBMIT_HEADER = 14
 
 EXIT_WORKER_FAULT = 86   # the worker_exit fault site's os._exit code
 
@@ -323,7 +330,19 @@ class ReplicaServer:
             raise ClusterProtocolError(f"short submit header: {len(ints)}")
         (max_tokens, temp_b, topp_b, rng_lo, rng_hi, vocab,
          deadline_ms, n_eos, trace_id, fill_port,
-         fill_expected, fill_donor) = ints[:_SUBMIT_HEADER]
+         fill_expected, fill_donor, prio_idx,
+         tenant_len) = ints[:_SUBMIT_HEADER]
+        # fairness tags (v5): the fill host is the payload's head, the
+        # tenant name its tail — split by the header's declared length
+        fill_payload, tenant = payload, None
+        if tenant_len > 0:
+            fill_payload = payload[:-tenant_len]
+            tenant = payload[-tenant_len:].decode("utf-8",
+                                                  errors="replace")
+        from .fleet import PRIORITIES
+
+        priority = (PRIORITIES[prio_idx]
+                    if 0 <= prio_idx < len(PRIORITIES) else "normal")
         eos = [int(t) for t in ints[_SUBMIT_HEADER:_SUBMIT_HEADER + n_eos]]
         prompt = [int(t) for t in ints[_SUBMIT_HEADER + n_eos:]]
         sampler = Sampler(int(vocab), temperature=_bits_f32(temp_b),
@@ -357,8 +376,8 @@ class ReplicaServer:
         if fill_port > 0 and self._kv_transfer and fill_budget >= 0.25:
             from .kv_transfer import fill_from_wire
 
-            host = (payload.decode("utf-8", errors="replace")
-                    if payload else "127.0.0.1")
+            host = (fill_payload.decode("utf-8", errors="replace")
+                    if fill_payload else "127.0.0.1")
             try:
                 sched = sup._sched
             except AttributeError:
@@ -380,7 +399,8 @@ class ReplicaServer:
             # the worker's own minting behavior unchanged)
             req = sup.submit(prompt, int(max_tokens), sampler,
                              eos_id=set(eos) or None, deadline=deadline,
-                             trace_id=int(trace_id) or None)
+                             trace_id=int(trace_id) or None,
+                             tenant=tenant, priority=priority)
         except QueueFull as e:
             self._refuse(conn, {"code": "queue_full", "message": str(e),
                                 "retry_after": e.retry_after})
@@ -708,6 +728,17 @@ def build_supervisor_factory(cfg: dict):
         draft=cfg.get("draft"), draft_len=int(cfg.get("draft_len", 0)),
         draft_vocab=cfg.get("draft_vocab"))
 
+    # multi-tenant weighted-fair admission (runtime/fleet.py): the budget
+    # SPEC ships in the config; the ledger lives worker-side, held
+    # outside the supervisor's generations so budgets survive rebuilds —
+    # fairness must hold in this worker's queue, where waiting happens
+    tb = serve.get("tenant_budgets")
+    if tb:
+        from .fleet import TenantLedger, WFQueue, parse_tenant_budgets
+
+        ledger = TenantLedger(parse_tenant_budgets(tb))
+        sup_kwargs["fair_queue_factory"] = lambda: WFQueue(ledger)
+
     return lambda: EngineSupervisor(engine_factory, **sup_kwargs)
 
 
@@ -750,6 +781,10 @@ def config_from_cli_args(args, serve_batch: int) -> dict:
             "stall_timeout": getattr(args, "stall_timeout", 0.0),
             "slo_ttft_ms": getattr(args, "slo_ttft_ms", None),
             "slo_itl_ms": getattr(args, "slo_itl_ms", None),
+            # weighted-fair admission (runtime/fleet.py): the raw
+            # --tenant-budgets spec ships so each worker's own WFQ
+            # orders its queue by the same weights/budgets
+            "tenant_budgets": getattr(args, "tenant_budgets", None),
         },
         # device-tier observability: the recompile sentinel freezes and
         # the attribution sampler sample INSIDE each worker; /admin/
@@ -1070,7 +1105,8 @@ class WorkerClient:
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
                deadline=None, trace_id: int = 0,
-               fill: tuple | None = None) -> _RemoteStream:
+               fill: tuple | None = None, tenant: str | None = None,
+               priority: str = "normal") -> _RemoteStream:
         """Place one request on the worker. Door refusals re-raise the
         SAME exception types the in-process supervisor uses (QueueFull /
         EngineUnready / PromptTooLong / SchedulerClosed), so the router's
@@ -1090,12 +1126,19 @@ class WorkerClient:
                        max(int((deadline - time.perf_counter()) * 1e3), 0))
         fill_host, fill_port, fill_expected, fill_donor = (
             fill or ("", 0, 0, 0))
+        # v5: priority rides as an index into fleet.PRIORITIES (-1 =
+        # untagged), the tenant as payload-tail bytes sized by tenant_len
+        from .fleet import PRIORITIES
+        prio_idx = (PRIORITIES.index(priority)
+                    if priority in PRIORITIES else -1)
+        tenant_bytes = (tenant or "").encode("utf-8")
         rng = sampler.rng_state
         ints = [int(max_tokens), _f32_bits(sampler.temperature),
                 _f32_bits(sampler.topp), rng & 0xFFFFFFFF,
                 (rng >> 32) & 0xFFFFFFFF, sampler.vocab_size,
                 deadline_ms, len(eos), int(trace_id), int(fill_port),
-                int(fill_expected), int(fill_donor), *eos, *prompt]
+                int(fill_expected), int(fill_donor), prio_idx,
+                len(tenant_bytes), *eos, *prompt]
         try:
             sock = self._connect()
         except (OSError, ClusterProtocolError) as e:
@@ -1103,7 +1146,7 @@ class WorkerClient:
                                 1.0) from e
         try:
             _send_frame(sock, RMSG_SUBMIT, ints,
-                        payload=fill_host.encode("utf-8"),
+                        payload=fill_host.encode("utf-8") + tenant_bytes,
                         timeout=self._io)
             frame = _recv_frame(sock, timeout=self._io)
         except (OSError, ClusterProtocolError) as e:

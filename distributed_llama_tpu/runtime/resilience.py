@@ -90,7 +90,8 @@ class EngineSupervisor:
                  slo_ttft_ms: float | None = None,
                  slo_itl_ms: float | None = None,
                  draft: str | None = None, draft_len: int = 0,
-                 draft_vocab: int | None = None):
+                 draft_vocab: int | None = None,
+                 fair_queue_factory=None):
         self._factory = engine_factory
         self._chunk = chunk
         # replica identity at the key-filtered fault sites (runtime/
@@ -122,6 +123,12 @@ class EngineSupervisor:
         self._draft = draft
         self._draft_len = int(draft_len)
         self._draft_vocab = draft_vocab
+        # multi-tenant weighted-fair admission (runtime/fleet.py): a
+        # zero-arg callable minting a fresh WFQueue per generation —
+        # the TenantLedger behind it is held by the CALLER (the fleet
+        # controller / API layer) so budgets survive rebuilds, the same
+        # externally-held discipline as the counter carry below
+        self._fair_queue_factory = fair_queue_factory
         self.max_queue = int(max_queue)
         self._queue_timeout = queue_timeout
         self._request_deadline = request_deadline
@@ -190,14 +197,16 @@ class EngineSupervisor:
         return not self.max_queue or len(sched._queue) < self.max_queue
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None, trace_id=None):
+               deadline=None, trace_id=None, tenant=None,
+               priority="normal"):
         with self._state_lock:
             if self._state != READY:
                 self.sup_stats.rejected_unready += 1
                 raise EngineUnready(self._state, self._retry_after())
             sched = self._sched
         req = sched.submit(prompt, max_tokens, sampler, eos_id=eos_id,
-                           deadline=deadline, trace_id=trace_id)
+                           deadline=deadline, trace_id=trace_id,
+                           tenant=tenant, priority=priority)
         if sched._stop and not req.finished.is_set():
             # the generation died between the state check and the enqueue:
             # its abort may already have drained the queue, so deliver this
@@ -379,7 +388,9 @@ class EngineSupervisor:
                          slo_itl_ms=self._slo_itl_ms,
                          draft_factory=draft_factory,
                          draft_len=self._draft_len,
-                         draft_vocab=self._draft_vocab)
+                         draft_vocab=self._draft_vocab,
+                         fair_queue=(self._fair_queue_factory()
+                                     if self._fair_queue_factory else None))
 
     def _start_loop(self, sched: Scheduler, gen: int) -> None:
         for g in [g for g, t in self._loop_threads.items()

@@ -57,7 +57,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from .resilience import _COUNTER_KEYS, EngineSupervisor, EngineUnready
 from .scheduler import QueueFull, RequestError, SchedulerClosed
@@ -102,6 +102,11 @@ class ReplicaHandle:
         self.sup = EngineSupervisor(engine_factory,
                                     fault_key=f"r{rid}", **self._sup_kwargs)
         self.draining = False   # router-level: out of rotation
+        # fleet-controller scale-down mark (runtime/fleet.py): a replica
+        # draining FOR REAP is a capacity decision, not a health event —
+        # /readyz and Router.state exclude it instead of reporting
+        # "draining"/unready for the whole tier
+        self.reap = False
         # router circuit breaker (see class docstring)
         self.fails = 0
         self.open_until = 0.0   # 0 = closed; else half-open past it
@@ -200,6 +205,7 @@ class ReplicaHandle:
         s["replica"] = self.id
         s["tier"] = self.tier
         s["draining"] = self.draining
+        s["reap"] = self.reap
         s["breaker_open"] = self.open_until > 0.0
         return s
 
@@ -354,6 +360,7 @@ class RemoteReplicaHandle:
             else "mixed"
         self.sup = self
         self.draining = False
+        self.reap = False  # fleet scale-down mark (see ReplicaHandle)
         self.fails = 0
         self.open_until = 0.0
         self.probing = False
@@ -421,7 +428,8 @@ class RemoteReplicaHandle:
         return _RemoteEngineInfo(self.client)
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None, trace_id=None, fill=None):
+               deadline=None, trace_id=None, fill=None, tenant=None,
+               priority="normal"):
         if self._broken or self._closed:
             raise EngineUnready(self.state, self._retry_after())
         if not self._health.get("ready"):
@@ -431,7 +439,8 @@ class RemoteReplicaHandle:
             raise EngineUnready(self.state, self._retry_after())
         return self.client.submit(prompt, max_tokens, sampler,
                                   eos_id=eos_id, deadline=deadline,
-                                  trace_id=trace_id or 0, fill=fill)
+                                  trace_id=trace_id or 0, fill=fill,
+                                  tenant=tenant, priority=priority)
 
     def exclusive(self):
         raise EngineUnready("remote replica: no borrowable local engine",
@@ -561,6 +570,7 @@ class RemoteReplicaHandle:
         base["replica"] = self.id
         base["tier"] = self.tier
         base["draining"] = self.draining
+        base["reap"] = self.reap
         base["breaker_open"] = self.open_until > 0.0
         proc = self.proc_stats.summary()
         proc["mode"] = "spawn" if self._proc is not None else "connect"
@@ -722,7 +732,7 @@ class RouterRequest:
 
     def __init__(self, router: "Router", prompt: list[int], max_tokens: int,
                  eos_id, deadline, sampler_spec: tuple, session,
-                 trace_id: int = 0):
+                 trace_id: int = 0, tenant=None, priority="normal"):
         # one span id for the WHOLE request: every failover attempt's
         # scheduler/worker events carry it, so the casualty and its
         # sibling retry share a timeline (runtime/trace.py)
@@ -734,6 +744,10 @@ class RouterRequest:
         self._deadline = deadline      # absolute: shared across attempts
         self._sampler_spec = sampler_spec  # (vocab, temp, topp, rng_state)
         self._session = session
+        # fairness tags: shared by every failover attempt (a retry rides
+        # the same tenant's share + the same priority band)
+        self._tenant = tenant
+        self._priority = priority
         self._inner = None             # current ServeRequest
         self._handle: ReplicaHandle | None = None
         self._probe = False            # current attempt IS the half-open probe
@@ -909,6 +923,20 @@ class Router:
         self._rr = 0  # dlrace: guarded-by(self._lock)
         self._affinity: OrderedDict[str, int] = OrderedDict()  # dlrace: guarded-by(self._lock)
         self._closed = False
+        # fleet-controller surface (runtime/fleet.py): `scaling` is the
+        # in-flight scale direction ("scaling_up"/"scaling_down"/None)
+        # the /readyz state report surfaces; `_spawn_factory(rid, tier)`
+        # is stashed by build_front_door so the controller can mint
+        # replicas the same way the constructor did; `_recent_prompts`
+        # is the warm-fill material a fresh replica replays (string/
+        # bool stores are GIL-atomic; the ring rides the router lock)
+        self.scaling: str | None = None
+        self._spawn_factory = None
+        self._recent_prompts: deque = deque(maxlen=32)  # dlrace: guarded-by(self._lock)
+        # lifetime counters of reaped replicas: fold-on-reap so /stats
+        # totals never reset when the controller scales down (the same
+        # carry contract restart()/respawn keep within one handle)
+        self._reap_carry = {k: 0 for k in _COUNTER_KEYS}  # dlrace: guarded-by(self._lock)
         # replicas build sequentially: each EngineSupervisor warms its
         # executables before returning, and the XLA compile cache makes
         # replicas 1..N-1 reuse replica 0's compilations
@@ -961,27 +989,37 @@ class Router:
         """Advisory tier state, CONSISTENT with ``ready``: "ready" iff
         some replica is actually routable (supervisor-ready, not drained,
         circuit allows) — a tier whose /readyz answers 503 must never
-        report state="ready" back at the operator."""
+        report state="ready" back at the operator. A fleet-controller
+        scale event in flight reports ``scaling_up``/``scaling_down``
+        instead (the tier is still serving — capacity is changing, not
+        health), and a replica marked ``reap`` is EXCLUDED from the
+        unhealthy walk: draining-for-reap is the controller's decision,
+        not a reason to call the tier draining."""
         now = time.perf_counter()
+        scaling = self.scaling
         with self._lock:
             if any(self._routable(h, now) for h in self.replicas):
-                return "ready"
-            states = [h.state for h in self.replicas]
+                return scaling or "ready"
+            live = [h for h in self.replicas if not h.reap]
+            if not live:
+                return scaling or "draining"
+            states = [h.state for h in live]
             for s in ("recovering", "draining"):
                 if s in states:
                     return s
-            if any(h.open_until > 0.0 for h in self.replicas):
+            if any(h.open_until > 0.0 for h in live):
                 # router circuits hold traffic off supervisor-ready
                 # replicas (the flapping case) — surface it, don't claim
                 # the supervisors' "ready"
                 return "degraded"
-            if any(h.draining for h in self.replicas):
+            if any(h.draining for h in live):
                 # router-level drain leaves the supervisor READY
                 return "draining"
             return states[0] if len(set(states)) == 1 else "degraded"
 
     def submit(self, prompt, max_tokens, sampler, eos_id=None,
-               deadline=None, session=None) -> RouterRequest:
+               deadline=None, session=None, tenant=None,
+               priority="normal") -> RouterRequest:
         """Place one request (PromptTooLong/QueueFull/EngineUnready
         surface here, exactly like the single-supervisor front door).
         ``sampler`` is consumed by the first attempt; its (temperature,
@@ -995,7 +1033,13 @@ class Router:
                 sampler.rng_state)
         tid = TRACER.new_id() if TRACER.enabled else 0
         req = RouterRequest(self, [int(t) for t in prompt], max_tokens,
-                            eos_id, deadline, spec, session, trace_id=tid)
+                            eos_id, deadline, spec, session, trace_id=tid,
+                            tenant=tenant, priority=priority)
+        with self._lock:
+            # warm-fill material for fleet scale-ups (runtime/fleet.py):
+            # a fresh replica replays the most recent prompts through
+            # the PR-14 fill path so its cache starts warm
+            self._recent_prompts.append(req._prompt)
         if self._kv_transfer:
             # prefill/decode disaggregation: run the prompt through a
             # prefill-tier replica first (publishes its blocks), so the
@@ -1058,7 +1102,10 @@ class Router:
         generations' request windows, the per-replica summaries, and the
         router block."""
         reps = [h.summary() for h in self.replicas]
-        out = {k: sum(r.get(k) or 0 for r in reps) for k in _COUNTER_KEYS}
+        with self._lock:
+            reap_carry = dict(self._reap_carry)
+        out = {k: sum(r.get(k) or 0 for r in reps) + reap_carry[k]
+               for k in _COUNTER_KEYS}
         ttfts, itls = [], []
         for h in self.replicas:
             for r in list(h.sup.stats.requests):
@@ -1069,6 +1116,7 @@ class Router:
         rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
         out.update({
             "state": self.state,
+            "scaling": self.scaling,
             "ttft_p50_ms": rnd(percentile(ttfts, 50)),
             "ttft_p99_ms": rnd(percentile(ttfts, 99)),
             "itl_p50_ms": rnd(percentile(itls, 50)),
@@ -1163,6 +1211,43 @@ class Router:
             self.restart_replica(h.id, timeout=timeout)
         return ok
 
+    # -- fleet autoscaling surface (runtime/fleet.py) ----------------------
+
+    def add_replica(self, handle) -> None:
+        """Enter an already-built (and therefore already-warm: every
+        handle constructor blocks on its warmup/handshake) replica into
+        rotation. The fleet controller builds the handle OFF the router
+        lock — possibly minutes of spawn + compile — and this entry is
+        one guarded list append, so placement never waits on a spawn."""
+        with self._lock:
+            assert all(h.id != handle.id for h in self.replicas), handle.id
+            self.replicas.append(handle)
+            self.stats.replicas = len(self.replicas)
+
+    def reap_replica(self, replica: int, timeout: float = 30.0) -> None:
+        """Remove ONE drained replica from rotation and close it (the
+        controller's scale-down tail: mark ``reap`` → drain → here).
+        Close-before-remove: the handle's close() retires its monitor
+        thread (so a respawn can never resurrect a reaped worker), and
+        only then does the list forget it."""
+        with self._lock:
+            matches = [h for h in self.replicas if h.id == replica]
+        if not matches:
+            return
+        h = matches[0]
+        h.reap = True
+        h.close(timeout=timeout)
+        final = h.summary()  # close() is final: no writer outlives it
+        with self._lock:
+            for k in _COUNTER_KEYS:
+                self._reap_carry[k] += final.get(k) or 0
+            self.replicas = [x for x in self.replicas if x.id != replica]
+            self.stats.replicas = len(self.replicas)
+            # drop stale stickiness onto the dead id: those sessions
+            # re-place fresh (losing affinity costs one cold placement)
+            for k in [k for k, v in self._affinity.items() if v == replica]:
+                del self._affinity[k]
+
     # -- placement ---------------------------------------------------------
 
     def _routable(self, h: ReplicaHandle, now: float) -> bool:
@@ -1174,6 +1259,11 @@ class Router:
         only prefill workers is therefore correctly unready. Caller
         holds the lock."""
         if getattr(h, "tier", "mixed") == "prefill":
+            return False
+        if h.reap:
+            # marked for fleet scale-down: out of rotation from the mark
+            # (its drain may not have started yet) — a reaped replica
+            # must never take the request that blocks its own reap
             return False
         if h.draining or h.sup is None or not h.sup.ready:
             return False
@@ -1375,14 +1465,18 @@ class Router:
                                          deadline=req._deadline,
                                          trace_id=req.trace_id,
                                          fill=(d_host, d_port,
-                                               d_expected, d_handle.id))
+                                               d_expected, d_handle.id),
+                                         tenant=req._tenant,
+                                         priority=req._priority)
                     self._note_fill_verdict(d_handle, req, inner,
                                             d_expected)
                 else:
                     inner = h.sup.submit(req._prompt, req._max_tokens,
                                          sampler, eos_id=req._eos_id,
                                          deadline=req._deadline,
-                                         trace_id=req.trace_id)
+                                         trace_id=req.trace_id,
+                                         tenant=req._tenant,
+                                         priority=req._priority)
             except (EngineUnready, QueueFull, SchedulerClosed) as e:
                 if probe:
                     self._release_probe(h)
@@ -1472,7 +1566,8 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                      slo_itl_ms: float | None = None,
                      draft: str | None = None, draft_len: int = 0,
                      draft_vocab: int | None = None,
-                     kv_transfer: bool = False, tiers=None):
+                     kv_transfer: bool = False, tiers=None,
+                     tenant_ledger=None):
     """The ONE constructor of the serving front door, shared by every
     deployment shape (the engine-owner logic that used to live in
     apps/api_server.ApiState.scheduler):
@@ -1491,7 +1586,16 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         supervision; each host's operator owns its worker's lifetime).
 
     The HTTP handlers serve all four through the identical duck-typed
-    surface."""
+    surface.
+
+    ``tenant_ledger`` (runtime/fleet.TenantLedger) arms weighted-fair
+    admission: every LOCAL scheduler generation gets a fresh WFQueue
+    over this one ledger (budgets survive rebuilds), and process
+    workers arm their own worker-side WFQ from the budget spec shipped
+    in ``worker_config`` (fairness must hold in the queue where waiting
+    actually happens). Router shapes also stash ``_spawn_factory`` so
+    the fleet controller (runtime/fleet.py) can mint replicas exactly
+    the way this constructor did."""
     from .engine import Engine
 
     if replica_procs or replica_hosts:
@@ -1506,29 +1610,35 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                 "replica_procs needs a worker_config dict"
             workdir = workdir or tempfile.mkdtemp(prefix="dllama-replicas-")
             os.makedirs(workdir, exist_ok=True)
-            for i in range(int(replica_procs)):
+
+            def spawn_factory(i, tier):
+                # the fleet controller mints replica i EXACTLY the way
+                # the loop below does (fresh cfg, fault_key=r{i}, same
+                # workdir/timeouts) — scale-ups and boot replicas are
+                # indistinguishable to chaos keys and respawn folds
                 cfg = dict(worker_config)
+                cfg["fault_key"] = f"r{i}"
+                cfg["kv_transfer"] = bool(kv_transfer)
+                cfg["tier"] = tier
+                proc = WorkerProc(i, cfg, workdir=workdir,
+                                  io_timeout=worker_io_timeout)
+                return RemoteReplicaHandle(
+                    i, proc=proc, block_len=prefix_block_len,
+                    io_timeout=worker_io_timeout,
+                    spawn_timeout=spawn_timeout,
+                    respawn_timeout=spawn_timeout, tier=tier)
+
+            for i in range(int(replica_procs)):
                 # replica identity at the key-filtered fault sites rides
                 # into the worker so DLLAMA_FAULTS key=rK follows replica
-                # K across respawns, same as the thread tier
-                cfg["fault_key"] = f"r{i}"
+                # K across respawns, same as the thread tier; the
                 # per-replica disaggregation role + transfer arming
-                # (runtime/kv_transfer.py) — stamped like fault_key so
-                # the role survives respawns
-                cfg["kv_transfer"] = bool(kv_transfer)
+                # (runtime/kv_transfer.py) are stamped the same way
                 tier = tiers[i] if tiers else "mixed"
-                cfg["tier"] = tier
-
-                def make(i=i, cfg=cfg, tier=tier):
-                    proc = WorkerProc(i, cfg, workdir=workdir,
-                                      io_timeout=worker_io_timeout)
-                    return RemoteReplicaHandle(
-                        i, proc=proc, block_len=prefix_block_len,
-                        io_timeout=worker_io_timeout,
-                        spawn_timeout=spawn_timeout,
-                        respawn_timeout=spawn_timeout, tier=tier)
-                factories.append(make)
+                factories.append(lambda i=i, tier=tier:
+                                 spawn_factory(i, tier))
         else:
+            spawn_factory = None
             for i, (host, port) in enumerate(replica_hosts):
                 def make(i=i, host=host, port=port):
                     return RemoteReplicaHandle(
@@ -1536,12 +1646,14 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
                         block_len=prefix_block_len,
                         io_timeout=worker_io_timeout)
                 factories.append(make)
-        return Router(None, policy=route_policy,
-                      retry_budget=retry_budget,
-                      handle_factories=factories,
-                      kv_transfer=kv_transfer,
-                      fill_min_tokens=prefix_block_len,
-                      request_deadline=request_deadline or None)
+        router = Router(None, policy=route_policy,
+                        retry_budget=retry_budget,
+                        handle_factories=factories,
+                        kv_transfer=kv_transfer,
+                        fill_min_tokens=prefix_block_len,
+                        request_deadline=request_deadline or None)
+        router._spawn_factory = spawn_factory
+        return router
 
     def engine_factory():
         # the launched engine's mesh carries over (tp serving — the
@@ -1567,6 +1679,11 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
     if prefix_cache:
         n_blocks = prefix_blocks or max(
             2 * serve_batch * engine.seq_len // prefix_block_len, 1)
+    fair_queue_factory = None
+    if tenant_ledger is not None:
+        from .fleet import WFQueue
+
+        fair_queue_factory = lambda: WFQueue(tenant_ledger)  # noqa: E731
     sup_kwargs = dict(
         chunk=serve_chunk or None,
         max_queue=queue_depth or 4 * serve_batch,
@@ -1574,12 +1691,20 @@ def build_front_door(engine, *, serve_batch: int, serve_chunk: int = 0,
         stall_timeout=stall_timeout or 10.0,
         prefix_blocks=n_blocks, prefix_block_len=prefix_block_len,
         slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
-        draft=draft, draft_len=draft_len, draft_vocab=draft_vocab)
+        draft=draft, draft_len=draft_len, draft_vocab=draft_vocab,
+        fair_queue_factory=fair_queue_factory)
     if replicas <= 1:
         return EngineSupervisor(engine_factory, kv_transfer=kv_transfer,
                                 **sup_kwargs)
-    return Router(engine_factory, replicas=replicas,
-                  policy=route_policy, retry_budget=retry_budget,
-                  kv_transfer=kv_transfer,
-                  fill_min_tokens=prefix_block_len, tiers=tiers,
-                  **sup_kwargs)
+    router = Router(engine_factory, replicas=replicas,
+                    policy=route_policy, retry_budget=retry_budget,
+                    kv_transfer=kv_transfer,
+                    fill_min_tokens=prefix_block_len, tiers=tiers,
+                    **sup_kwargs)
+    # the fleet controller scales THREAD replicas too (tests drive the
+    # loop without subprocesses): a scale-up builds a fresh supervised
+    # replica over the same shared weight buffers
+    router._spawn_factory = lambda rid, tier: ReplicaHandle(
+        rid, engine_factory, dict(sup_kwargs, kv_transfer=kv_transfer),
+        tier=tier)
+    return router

@@ -118,8 +118,14 @@ class ServeRequest:
 
     def __init__(self, rid: int, prompt: list[int], max_tokens: int,
                  sampler, stop_ids: set[int],
-                 deadline: float | None = None, trace_id: int = 0):
+                 deadline: float | None = None, trace_id: int = 0,
+                 tenant: str | None = None, priority: str = "normal"):
         self.id = rid
+        # multi-tenant fairness tags (runtime/fleet.py): which tenant's
+        # WFQ share + token budget this request rides, and its priority
+        # band — inert under the plain FIFO deque, read by WFQueue
+        self.tenant = tenant
+        self.priority = priority
         # flight-recorder span id (runtime/trace.py): minted ONCE per
         # client request at the front door and shared by every retry
         # attempt (and, across the process boundary, by the worker's
@@ -364,7 +370,8 @@ class Scheduler:
                  slo_itl_ms: float | None = None,
                  draft_factory=None, draft_len: int = 0,
                  draft_vocab: int | None = None,
-                 sample_vocab: int | None = None):
+                 sample_vocab: int | None = None,
+                 fair_queue=None):
         self.engine = engine
         # identifies THIS scheduler at the replica-level fault sites
         # (runtime/faults.py replica_raise/replica_stall): the router
@@ -428,7 +435,19 @@ class Scheduler:
         # in-flight forward (measured: mutex-taking submits stalled a
         # 2.8 s arrival trace to 8.5 s behind back-to-back steps — lock
         # handoff is not FIFO)
-        self._queue: deque[ServeRequest] = deque()  # dlrace: guarded-by(self._mutex)
+        # fair_queue (runtime/fleet.WFQueue) duck-types this exact deque
+        # slice — append/popleft/len/bool — swapping FIFO admission for
+        # weighted-fair when tenant budgets are armed; its own internal
+        # lock is tiny and never held across a forward, preserving the
+        # cheap-submit constraint above
+        self._queue = (fair_queue if fair_queue is not None
+                       else deque())  # dlrace: guarded-by(self._mutex)
+        # fleet overload ladder actuator (runtime/fleet.ShedLadder rung
+        # "no_spec"): ORs with the admission policy's own spec gate —
+        # either may turn drafting off, both must agree to turn it on.
+        # Bool store/read is atomic under the GIL; written by the fleet
+        # controller thread, read by the stepping thread.
+        self.spec_degraded = False
         self._mutex = threading.RLock()  # step()/exclusive() mutual excl.
         self._wake = threading.Event()
         self.stats = ServeStats()
@@ -454,7 +473,9 @@ class Scheduler:
     def submit(self, prompt: list[int], max_tokens: int, sampler,
                eos_id: int | set[int] | None = None,
                deadline: float | None = None,
-               trace_id: int | None = None) -> ServeRequest:
+               trace_id: int | None = None,
+               tenant: str | None = None,
+               priority: str = "normal") -> ServeRequest:
         """Enqueue a request; it joins the running batch as soon as a slot
         frees. `sampler` is PER REQUEST (its RNG stream is the slot's
         sampling state — concurrent requests never share coins).
@@ -491,7 +512,8 @@ class Scheduler:
             # retries share one id and passes it through here)
             trace_id = TRACER.new_id() if TRACER.enabled else 0
         req = ServeRequest(rid, prompt, max_tokens, sampler, stop_ids,
-                           deadline=deadline, trace_id=trace_id)
+                           deadline=deadline, trace_id=trace_id,
+                           tenant=tenant, priority=priority)
         req.stats.t_submit = now
         if TRACER.enabled:
             TRACER.event("enqueue", trace_id, rid=rid,
@@ -611,6 +633,7 @@ class Scheduler:
         # — when the live ITL EWMA endangers the SLO, the scheduler
         # falls back to plain (B, 1) decode steps until it recovers
         spec_ok = (self.draft is not None
+                   and not self.spec_degraded
                    and (self.admission is None
                         or self.admission.spec_allowed))
         if self.draft is not None and dec and not spec_ok:

@@ -605,6 +605,58 @@ class KVTransferStats:
 
 
 @dataclasses.dataclass
+class FleetStats:
+    """Counters owned by runtime/fleet.FleetController — the
+    measurement→decision loop over the serving fleet: autoscale
+    decisions (spawns, reaps, HBM-blocked refusals, spawn failures +
+    backoff), the overload ladder's position, and door-level sheds by
+    reason. Surfaced as the ``fleet`` /stats block + the
+    ``dllama_fleet_*`` /metrics family in EVERY tier incl. idle
+    (enabled=False, zeros: a tier must never lose a metric family to a
+    launch flag); the per-tenant admitted/shed/budget ledger rides the
+    same block from the controller's TenantLedger."""
+
+    enabled: bool = False
+    ticks: int = 0             # controller observation rounds
+    pressure: float = 0.0      # last observed serve-tier pressure
+    rung: int = 0              # overload ladder position (0 = healthy)
+    target_replicas: int = 0   # what the controller wants
+    scale_ups: int = 0         # replicas spawned into rotation
+    scale_downs: int = 0       # replicas drained + reaped
+    scale_blocked_hbm: int = 0  # spawns refused by the HBM ceiling
+    spawn_failures: int = 0    # scale-up spawns that died (→ backoff)
+    warm_fills: int = 0        # sibling KV fills into fresh replicas
+    sheds: int = 0             # door rejections by the ladder
+    clamped: int = 0           # admissions with max_tokens clamped
+
+    def __post_init__(self):
+        import threading
+
+        # shed rejections keyed by ladder reason ("shed"/"prefix_only")
+        self.sheds_by_reason: dict[str, int] = {}
+        # counter mutations ride this lock (the controller thread, its
+        # spawn/reap worker threads, and the API door all write here)
+        self.lock = threading.Lock()
+
+    def summary(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "ticks": self.ticks,
+            "pressure": self.pressure,
+            "rung": self.rung,
+            "target_replicas": self.target_replicas,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "scale_blocked_hbm": self.scale_blocked_hbm,
+            "spawn_failures": self.spawn_failures,
+            "warm_fills": self.warm_fills,
+            "sheds": self.sheds,
+            "clamped": self.clamped,
+            "sheds_by_reason": dict(self.sheds_by_reason),
+        }
+
+
+@dataclasses.dataclass
 class RouterStats:
     """Counters owned by runtime/router.Router — placement decisions,
     failover retries, and per-replica breaker events, surfaced as the

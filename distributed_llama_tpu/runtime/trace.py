@@ -105,6 +105,14 @@ EVENT_KINDS = (
     #                   runtime/profiler.CompileLedger
     "compile_after_warmup",  # the recompile sentinel fired (key, frozen)
     "profile",        # an /admin/profile capture completed (dir, ms)
+    "scale_up",       # fleet controller added a replica (replica, tier,
+    #                   pressure, ms, warm_fills — runtime/fleet.py)
+    "scale_down",     # fleet controller drained + reaped a replica
+    #                   (replica, tier, ms)
+    "shed",           # overload door refused/degraded a request (reason,
+    #                   tenant, rung, retry_after)
+    "degrade",        # shed ladder moved a rung (rung, name, direction,
+    #                   pressure)
 )
 
 
@@ -803,6 +811,89 @@ def _add_admission(p: _Prom, adm: dict | None, *,
           labels, help_=f"Live time-to-first-token EWMA{per}")
 
 
+_FLEET_COUNTERS = (
+    ("scale_ups", "fleet_scale_ups_total",
+     "Replicas added by the autoscaler"),
+    ("scale_downs", "fleet_scale_downs_total",
+     "Replicas drained and reaped by the autoscaler"),
+    ("scale_blocked_hbm", "fleet_scale_blocked_hbm_total",
+     "Scale-ups refused by the HBM ledger's slots_addable ceiling"),
+    ("spawn_failures", "fleet_spawn_failures_total",
+     "Scale-up spawns that failed (controller backs off)"),
+    ("warm_fills", "fleet_warm_fills_total",
+     "KV warm-fills replayed into fresh replicas from siblings"),
+    ("sheds", "fleet_sheds_total",
+     "Requests refused by the overload ladder"),
+    ("clamped", "fleet_clamped_total",
+     "Requests admitted with max_tokens clamped by the ladder"),
+)
+
+
+def _add_fleet(p: _Prom, fleet: dict | None, *,
+               labels: dict | None = None,
+               prefix: str = "dllama_") -> None:
+    """The fleet-brain family (runtime/fleet.py, stats.FleetStats +
+    FleetController summary): autoscale decisions, ladder rung, and
+    per-tenant fairness in every tier incl. idle — like kv_transfer,
+    the block is attached even with the controller off (enabled=False,
+    zeros), so the family can never vanish off a launch flag."""
+    if not fleet:
+        return
+    p.add(f"{prefix}fleet_info", 1,
+          {**(labels or {}), "enabled": str(bool(fleet.get("enabled"))),
+           "autoscaling": str(bool(fleet.get("autoscaling")))},
+          help_="Fleet controller identity (constant 1)")
+    p.add(f"{prefix}fleet_ticks_total", fleet.get("ticks"), labels,
+          type_="counter", help_="Controller decision ticks")
+    p.add(f"{prefix}fleet_pressure", fleet.get("pressure"), labels,
+          help_="Smoothed occupancy pressure the scaler steers on (0-1)")
+    p.add(f"{prefix}fleet_replicas", fleet.get("actual_replicas"),
+          {**(labels or {}), "kind": "actual"},
+          help_="Replica counts as the controller sees them")
+    p.add(f"{prefix}fleet_replicas", fleet.get("target_replicas"),
+          {**(labels or {}), "kind": "target"})
+    p.add(f"{prefix}fleet_replicas", fleet.get("min_replicas"),
+          {**(labels or {}), "kind": "min"})
+    p.add(f"{prefix}fleet_replicas", fleet.get("max_replicas"),
+          {**(labels or {}), "kind": "max"})
+    for key, name, help_ in _FLEET_COUNTERS:
+        p.add(f"{prefix}{name}", fleet.get(key), labels, type_="counter",
+              help_=help_)
+    for reason, n in (fleet.get("sheds_by_reason") or {}).items():
+        p.add(f"{prefix}fleet_sheds_by_reason_total", n,
+              {**(labels or {}), "reason": _esc(reason)}, type_="counter",
+              help_="Ladder refusals by rung reason")
+    ladder = fleet.get("ladder")
+    if ladder:
+        p.add(f"{prefix}fleet_ladder_rung", ladder.get("rung"),
+              {**(labels or {}), "name": _esc(ladder.get("name"))},
+              help_="Current shed-ladder rung (0 = healthy)")
+        p.add(f"{prefix}fleet_ladder_moves_total",
+              ladder.get("escalations"),
+              {**(labels or {}), "direction": "escalate"},
+              type_="counter", help_="Ladder rung transitions")
+        p.add(f"{prefix}fleet_ladder_moves_total", ladder.get("recoveries"),
+              {**(labels or {}), "direction": "recover"}, type_="counter")
+        p.add(f"{prefix}fleet_retry_after_seconds",
+              ladder.get("retry_after_s"), labels,
+              help_="Live drain-rate-derived Retry-After hint")
+    for name, row in (fleet.get("tenants") or {}).items():
+        lab = {**(labels or {}), "tenant": _esc(name)}
+        p.add(f"{prefix}fleet_tenant_weight", row.get("weight"), lab,
+              help_="Configured weighted-fair share")
+        p.add(f"{prefix}fleet_tenant_admitted_total", row.get("admitted"),
+              lab, type_="counter", help_="Requests admitted per tenant")
+        p.add(f"{prefix}fleet_tenant_shed_total", row.get("shed"), lab,
+              type_="counter", help_="Requests shed per tenant")
+        p.add(f"{prefix}fleet_tenant_tokens_charged_total",
+              row.get("tokens_charged"), lab, type_="counter",
+              help_="Token cost charged against the tenant budget")
+        if row.get("budget_remaining") is not None:
+            p.add(f"{prefix}fleet_tenant_budget_remaining",
+                  row.get("budget_remaining"), lab,
+                  help_="Token-bucket balance (absent = unlimited)")
+
+
 def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
                       model: str = "dllama", mode: str = "scheduler",
                       state: str | None = None,
@@ -870,6 +961,7 @@ def render_prometheus(summary: dict | None, *, tracer: Tracer | None = None,
         _add_admission(p, summary.get("admission"))
         _add_spec(p, summary.get("spec"))
         _add_kv_transfer(p, summary.get("kv_transfer"))
+        _add_fleet(p, summary.get("fleet"))
         _add_device_blocks(p, summary)
         for rep in summary.get("replicas") or ():
             lab = {"replica": str(rep.get("replica"))}
